@@ -1,0 +1,24 @@
+"""dlbb_tpu — a TPU-native (JAX/XLA) distributed-communication benchmark framework.
+
+Brand-new implementation of the capabilities of
+``hardik-jinda/distributed-llm-backend-benchmark`` (reference mounted read-only at
+``/root/reference``), re-designed TPU-first:
+
+- ``comm``   — device-mesh bootstrap + collective op registry (shard_map over
+  ``jax.lax`` collectives), replacing the reference's MPI/Gloo/oneCCL process
+  groups (reference ``run_mpi.py:29-49``, ``collectives/1d/dsgloo.py:53-67``).
+- ``bench``  — one declarative sweep/timing harness replacing the reference's
+  eight near-identical benchmark scripts (``collectives/{1d,3d}/*.py``).
+- ``stats``  — offline statistics pipeline with reference-compatible JSON/CSV
+  schemas (``collectives/1d/stats.py``, ``collectives/3d/stats.py``).
+- ``models`` — Megatron-style tensor-parallel decoder via GSPMD partition specs
+  (reference ``models.py``), 1B/7B/13B configs.
+- ``train``  — DDP / ZeRO-1 training loop (reference ``test/ccl.py:59-117``).
+- ``data``   — synthetic seeded embedding batches (reference ``data_gen.py``).
+- ``utils``  — metrics, timing, config IO, system info (reference ``utils.py``).
+
+No code is copied from the reference; citations in docstrings are for parity
+auditing only.
+"""
+
+__version__ = "0.1.0"

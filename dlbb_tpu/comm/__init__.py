@@ -1,0 +1,39 @@
+"""Mesh + collectives layer (L0/L1 replacement).
+
+The reference bottoms out in external C/C++ comm libraries driven through
+mpi4py / deepspeed.comm (reference SURVEY L0-L1). The TPU-native equivalent is
+XLA's collective runtime over ICI/DCN, reached through ``jax.lax`` collectives
+inside ``jax.shard_map`` over a ``jax.sharding.Mesh``.
+"""
+
+from dlbb_tpu.comm.mesh import (
+    DEFAULT_AXIS,
+    MeshSpec,
+    build_mesh,
+    flat_axes,
+    initialize_distributed,
+    mesh_num_ranks,
+)
+from dlbb_tpu.comm.ops import (
+    OPERATIONS,
+    CollectiveOp,
+    get_op,
+    make_payload,
+)
+from dlbb_tpu.comm.variants import VARIANTS, Variant, get_variant
+
+__all__ = [
+    "DEFAULT_AXIS",
+    "MeshSpec",
+    "build_mesh",
+    "flat_axes",
+    "initialize_distributed",
+    "mesh_num_ranks",
+    "OPERATIONS",
+    "CollectiveOp",
+    "get_op",
+    "make_payload",
+    "VARIANTS",
+    "Variant",
+    "get_variant",
+]

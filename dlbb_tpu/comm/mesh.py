@@ -1,0 +1,157 @@
+"""Device-mesh construction and multi-host bootstrap.
+
+TPU-native replacement for the reference's bootstrap / process-group layer:
+
+- reference ``run_mpi.py:29-49`` (``initialize_mpi_backend`` /
+  ``cleanup_mpi_backend`` via mpi4py ``MPI.COMM_WORLD``),
+- reference ``collectives/1d/dsgloo.py:53-67`` and ``dsccl.py:47-57``
+  (``deepspeed.init_distributed``),
+- reference rank/core binding tables ``collectives/3d/config_{4,8}.txt``.
+
+Instead of mpirun-spawned ranks holding an opaque communicator, we build a
+``jax.sharding.Mesh`` over the devices XLA exposes.  "Rank count" becomes the
+mesh size; "topology tuning" becomes the mesh *shape* (1D ring vs multi-axis),
+which is how ICI reductions are steered on TPU.
+
+Development happens on a CPU-simulated mesh:
+``XLA_FLAGS=--xla_force_host_platform_device_count=N JAX_PLATFORMS=cpu``
+gives N fake devices in one process — the idiomatic JAX analogue of
+``mpirun -np N`` on localhost (reference ``collectives/launch_openmpi.sh:5-12``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Single flat collective axis used by the 1D microbenchmarks — the analogue of
+# MPI_COMM_WORLD's rank dimension.
+DEFAULT_AXIS = "ranks"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh description.
+
+    Replaces the reference's ``RANK_COUNTS`` module constants
+    (``collectives/1d/openmpi.py:19-20``) and core-binding tables with a
+    first-class config object.
+
+    shape:      devices per mesh axis, e.g. ``(8,)`` or ``(2, 2, 2)``.
+    axis_names: one name per axis, e.g. ``("ranks",)`` or ``("x","y","z")``.
+    """
+
+    shape: tuple[int, ...]
+    axis_names: tuple[str, ...] = (DEFAULT_AXIS,)
+
+    def __post_init__(self) -> None:
+        if len(self.shape) != len(self.axis_names):
+            raise ValueError(
+                f"shape {self.shape} and axis_names {self.axis_names} "
+                "must have the same length"
+            )
+
+    @classmethod
+    def ring(cls, num_ranks: int, axis: str = DEFAULT_AXIS) -> "MeshSpec":
+        """1D ring of ``num_ranks`` devices — the default microbenchmark mesh."""
+        return cls((num_ranks,), (axis,))
+
+    @classmethod
+    def grid(cls, shape: Sequence[int], axis_names: Sequence[str]) -> "MeshSpec":
+        """Multi-axis mesh, e.g. ``grid((2,2,2), ("x","y","z"))`` for the
+        hierarchical-allreduce benchmark (BASELINE.json config 3)."""
+        return cls(tuple(shape), tuple(axis_names))
+
+    @property
+    def num_ranks(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def name(self) -> str:
+        return "x".join(str(s) for s in self.shape)
+
+
+def available_devices(platform: Optional[str] = None) -> list:
+    """All addressable-or-not devices, optionally filtered by platform."""
+    if platform is None:
+        return list(jax.devices())
+    return list(jax.devices(platform))
+
+
+def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a ``jax.sharding.Mesh`` for ``spec`` from the first
+    ``spec.num_ranks`` devices.
+
+    Mirrors the reference's world-size gate (``collectives/1d/openmpi.py:210-214``,
+    ``run_mpi.py:73-77``): raises if fewer devices are available than the spec
+    needs, so sweeps can skip infeasible rank counts.
+    """
+    devs = list(devices) if devices is not None else available_devices()
+    n = spec.num_ranks
+    if len(devs) < n:
+        raise ValueError(
+            f"mesh spec {spec.shape} needs {n} devices, "
+            f"only {len(devs)} available"
+        )
+    grid = np.asarray(devs[:n], dtype=object).reshape(spec.shape)
+    return Mesh(grid, spec.axis_names)
+
+
+def mesh_num_ranks(mesh: Mesh, axes: Optional[Sequence[str]] = None) -> int:
+    """Total ranks along ``axes`` (all axes if None)."""
+    names = tuple(axes) if axes is not None else tuple(mesh.axis_names)
+    return math.prod(mesh.shape[a] for a in names)
+
+
+def flat_axes(mesh: Mesh) -> tuple[str, ...]:
+    """All axis names of a mesh, for collectives that reduce over the whole
+    mesh (hierarchical variants reduce over them one at a time instead)."""
+    return tuple(mesh.axis_names)
+
+
+@dataclass
+class DistributedContext:
+    """What the reference's ``initialize_mpi_backend`` returns — ``(rank,
+    world_size, comm)`` (``run_mpi.py:29-43``) — recast for JAX multi-host:
+    process index/count at the host level, device count at the chip level."""
+
+    process_id: int = 0
+    num_processes: int = 1
+    num_devices: int = field(default_factory=lambda: len(jax.devices()))
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> DistributedContext:
+    """Multi-host bootstrap — the TPU-pod analogue of ``mpirun`` +
+    ``MPI.COMM_WORLD`` (reference ``run_mpi.py:29-43``) and of the DeepSpeed
+    launcher env handshake (``collectives/3d/launch_dsccl.sh:69-74``).
+
+    On a TPU pod slice, ``jax.distributed.initialize()`` with no arguments
+    auto-discovers coordinator/processes from the TPU metadata server.  On a
+    single host (including the CPU-simulated mesh) this is a no-op.
+    """
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif coordinator_address is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address)
+    return DistributedContext(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        num_devices=len(jax.devices()),
+    )

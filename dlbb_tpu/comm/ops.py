@@ -1,0 +1,306 @@
+"""Collective operation registry.
+
+TPU-native re-design of the reference's per-backend benchmark functions
+(``collectives/1d/openmpi.py:55-198``, ``collectives/1d/dsgloo.py:73-212``):
+one registry of SPMD collectives built from ``jax.lax`` primitives under
+``jax.shard_map``, instead of four copies of eight hand-written
+MPI/torch.distributed wrappers.
+
+Data model
+----------
+MPI programs are MIMD: every rank holds its *own* buffer.  The SPMD encoding
+used here is a *global* array whose leading axis is the rank axis, sharded over
+the mesh — device ``i`` holds row ``i``, exactly the per-rank buffer of the
+reference.  Ops that send a buffer-per-peer (scatter/alltoall) take a global
+``[P, P, n]`` array (device ``i`` holds its ``[P, n]`` sendbuf).
+
+Root-rooted ops (broadcast / gather / scatter / reduce) have no native SPMD
+analogue (SURVEY §7 "hard parts"); they are composed from symmetric
+collectives + masking by ``lax.axis_index``:
+
+- broadcast  = psum(where(rank == root, x, 0))            (exact: one term)
+- reduce     = where(rank == root, psum(x), 0)
+- gather     = where(rank == root, all_gather(x), 0)
+- scatter    = psum-broadcast root's sendbuf, then slice own row
+
+The ring sendrecv of the reference (``collectives/1d/openmpi.py:173-198``,
+Isend/Irecv to (rank±1) mod P) maps to ``lax.ppermute`` with a ring
+permutation, which XLA lowers to neighbour ICI transfers.
+
+Reduce-scatter (``lax.psum_scatter``) is added beyond the reference's eight
+ops because it is the primitive under ZeRO-1 (BASELINE.json config 5).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlbb_tpu.comm.mesh import DEFAULT_AXIS, mesh_num_ranks
+
+
+@dataclass(frozen=True)
+class CollectiveOp:
+    """One benchmarkable collective.
+
+    input_kind:
+      "per_rank"  — global ``[P, n]``, device i owns row i (one buffer/rank)
+      "per_peer"  — global ``[P, P, n]``, device i owns slab i (one buffer per
+                    peer, as for MPI_Scatter's root sendbuf / MPI_Alltoall)
+    """
+
+    name: str
+    input_kind: str
+    build: Callable[..., Callable]  # (mesh, axes, root) -> fn(global) -> global
+
+
+def _rank_id(axes: Sequence[str]) -> jax.Array:
+    """Linearised rank index over possibly-multiple mesh axes."""
+    idx = jnp.int32(0)
+    for a in axes:
+        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+    return idx
+
+
+def _specs(mesh: Mesh, axes: Sequence[str], ndim: int) -> P:
+    """PartitionSpec sharding the leading (rank) axis over ``axes``."""
+    return P(tuple(axes), *([None] * (ndim - 1)))
+
+
+def _wrap(mesh: Mesh, axes: Sequence[str], body, in_ndim: int, out_ndim: int):
+    spec_in = _specs(mesh, axes, in_ndim)
+    spec_out = _specs(mesh, axes, out_ndim)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=spec_in, out_specs=spec_out)
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# op builders — each returns fn(global_array) -> global_array
+# ---------------------------------------------------------------------------
+
+
+def _reduce_over(x, axes: Sequence[str], reduce_op: str):
+    if reduce_op == "sum":
+        return jax.lax.psum(x, tuple(axes))
+    if reduce_op == "max":
+        return jax.lax.pmax(x, tuple(axes))
+    if reduce_op == "min":
+        return jax.lax.pmin(x, tuple(axes))
+    if reduce_op == "prod":
+        # No pprod primitive: gather then reduce locally (exact, unlike
+        # exp(psum(log)) which fails on zeros/negatives).
+        g = jax.lax.all_gather(x, tuple(axes))
+        return jnp.prod(g, axis=0)
+    raise ValueError(f"unknown reduce op {reduce_op!r}")
+
+
+def build_allreduce(mesh, axes, root=0, reduce_op="sum"):
+    """MPI_Allreduce (reference ``collectives/1d/openmpi.py:55-67``;
+    MAX/MIN/PROD variants per ``test/test_open.py:248``)."""
+
+    def body(x):  # local [1, n]
+        return _reduce_over(x, axes, reduce_op)
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+def build_allreduce_hierarchical(mesh, axes, root=0, reduce_op="sum"):
+    """Hierarchical allreduce: reduce one mesh axis at a time (e.g. 2x2x2),
+    the ICI analogue of oneCCL's topo-aware algorithms
+    (``collectives/3d/launch_dsccl.sh:46-47``; BASELINE.json config 3)."""
+    if reduce_op != "sum":
+        raise ValueError("hierarchical allreduce supports sum only")
+
+    def body(x):
+        for a in axes:
+            x = jax.lax.psum(x, a)
+        return x
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+def build_allgather(mesh, axes, root=0):
+    """MPI_Allgather (reference ``collectives/1d/openmpi.py:84-96``):
+    per-rank [n] -> every rank holds [P*n]."""
+
+    def body(x):  # local [1, *shape] -> [1, P, *shape]
+        g = jax.lax.all_gather(x[0], tuple(axes))  # [P, *shape]
+        return g[None]
+
+    # Output keeps the per-rank payload structure — global [P, P, *shape],
+    # consistent with gather — whether the payload is flat [n] (1D sweeps)
+    # or (B, S, H) (3D sweeps).  PartitionSpecs shorter than the array rank
+    # are padded with None, so the spec arity below covers both.
+    return _wrap(mesh, axes, body, 2, 3)
+
+
+def build_broadcast(mesh, axes, root=0):
+    """MPI_Bcast from ``root`` (reference ``collectives/1d/openmpi.py:98-110``).
+    Exact psum-of-masked: only the root contributes a non-zero term."""
+
+    def body(x):
+        contrib = jnp.where(_rank_id(axes) == root, x, jnp.zeros_like(x))
+        return jax.lax.psum(contrib, tuple(axes))
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+def build_gather(mesh, axes, root=0):
+    """MPI_Gather to ``root`` (reference ``collectives/1d/openmpi.py:112-124``).
+    Output [P, P, n]: root's slab holds every rank's buffer, others zero —
+    SPMD has no "None on non-root", so non-root slabs are zeroed."""
+
+    def body(x):  # local [1, n] -> [1, P, n]
+        g = jax.lax.all_gather(x[0], tuple(axes))  # [P, n]
+        keep = (_rank_id(axes) == root).astype(g.dtype)
+        return (g * keep)[None]
+
+    return _wrap(mesh, axes, body, 2, 3)
+
+
+def build_scatter(mesh, axes, root=0):
+    """MPI_Scatter from ``root`` (reference ``collectives/1d/openmpi.py:126-140``):
+    root's [P, n] sendbuf -> rank i receives row i.  Broadcast root's sendbuf
+    (psum of masked) then each rank slices its own row."""
+
+    def body(x):  # local [1, P, n] -> [1, n]
+        me = _rank_id(axes)
+        contrib = jnp.where(me == root, x[0], jnp.zeros_like(x[0]))
+        sendbuf = jax.lax.psum(contrib, tuple(axes))  # [P, n] — root's buffer
+        row = jax.lax.dynamic_index_in_dim(sendbuf, me, axis=0, keepdims=False)
+        return row[None]
+
+    return _wrap(mesh, axes, body, 3, 2)
+
+
+def build_reduce(mesh, axes, root=0, reduce_op="sum"):
+    """MPI_Reduce to ``root`` (reference ``collectives/1d/openmpi.py:142-155``):
+    full reduction, result kept on root only (others zeroed)."""
+
+    def body(x):
+        total = _reduce_over(x, axes, reduce_op)
+        keep = (_rank_id(axes) == root).astype(total.dtype)
+        return total * keep
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+def build_alltoall(mesh, axes, root=0):
+    """MPI_Alltoall (reference ``collectives/1d/openmpi.py:157-171``):
+    device i's slab [P, n] holds a chunk per peer; chunk j goes to rank j."""
+    if len(axes) != 1:
+        raise ValueError("alltoall requires a single mesh axis")
+
+    def body(x):  # local [1, P, n]
+        return jax.lax.all_to_all(x, axes[0], split_axis=1, concat_axis=1)
+
+    return _wrap(mesh, axes, body, 3, 3)
+
+
+def build_sendrecv(mesh, axes, root=0):
+    """Ring sendrecv (reference ``collectives/1d/openmpi.py:173-198``:
+    Isend to (rank+1)%P, Irecv from (rank-1)%P, waitall).  ``lax.ppermute``
+    with the ring permutation lowers to neighbour ICI transfers."""
+    if len(axes) != 1:
+        raise ValueError("sendrecv ring requires a single mesh axis")
+    num = mesh_num_ranks(mesh, axes)
+    perm = [(i, (i + 1) % num) for i in range(num)]
+
+    def body(x):  # local [1, n]
+        return jax.lax.ppermute(x, axes[0], perm)
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+def build_reducescatter(mesh, axes, root=0):
+    """MPI_Reduce_scatter (not in the reference's 8 ops; the ZeRO-1 primitive
+    — BASELINE.json config 5; reference ZeRO usage at ``test/ccl.py:86-89``)."""
+    if len(axes) != 1:
+        raise ValueError("reducescatter requires a single mesh axis")
+
+    def body(x):  # local [1, P, n] -> [1, 1, n]
+        out = jax.lax.psum_scatter(x[0], axes[0], scatter_dimension=0)  # [n]
+        return out[None, None]
+
+    return _wrap(mesh, axes, body, 3, 3)
+
+
+def build_barrier(mesh, axes, root=0):
+    """Barrier analogue (reference ``collectives/1d/openmpi.py:60``:
+    ``comm.Barrier()`` before each timed op).  In XLA's async-dispatch model a
+    tiny psum + ``block_until_ready`` is the synchronisation point."""
+
+    def body(x):  # local [1, 1]
+        return jax.lax.psum(x, tuple(axes))
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+OPERATIONS: dict[str, CollectiveOp] = {
+    "allreduce": CollectiveOp("allreduce", "per_rank", build_allreduce),
+    "allgather": CollectiveOp("allgather", "per_rank", build_allgather),
+    "broadcast": CollectiveOp("broadcast", "per_rank", build_broadcast),
+    "gather": CollectiveOp("gather", "per_rank", build_gather),
+    "scatter": CollectiveOp("scatter", "per_peer", build_scatter),
+    "reduce": CollectiveOp("reduce", "per_rank", build_reduce),
+    "alltoall": CollectiveOp("alltoall", "per_peer", build_alltoall),
+    "sendrecv": CollectiveOp("sendrecv", "per_rank", build_sendrecv),
+    "reducescatter": CollectiveOp("reducescatter", "per_peer", build_reducescatter),
+    "allreduce_hierarchical": CollectiveOp(
+        "allreduce_hierarchical", "per_rank", build_allreduce_hierarchical
+    ),
+}
+
+
+def get_op(name: str) -> CollectiveOp:
+    try:
+        return OPERATIONS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown collective {name!r}; known: {sorted(OPERATIONS)}"
+        ) from None
+
+
+def make_payload(
+    op: CollectiveOp,
+    mesh: Mesh,
+    axes: Sequence[str],
+    num_elements: int,
+    dtype=jnp.bfloat16,
+    seed: int = 42,
+    shape: Optional[tuple[int, ...]] = None,
+) -> jax.Array:
+    """Build the global, mesh-sharded input for ``op``.
+
+    Per-rank data is seeded ``seed + rank`` exactly like the reference
+    (``collectives/1d/openmpi.py:247-248``, ``data_gen.py:37``).  ``shape``
+    overrides the per-rank payload shape (3D benchmarks pass ``(B, S, H)``,
+    reference ``collectives/3d/openmpi.py:21-23``); otherwise the payload is a
+    flat ``[num_elements]`` vector as in the 1D benchmarks.
+    """
+    num = mesh_num_ranks(mesh, axes)
+    per_rank_shape = tuple(shape) if shape is not None else (num_elements,)
+    rows = []
+    for rank in range(num):
+        rng = np.random.default_rng(seed + rank)
+        rows.append(rng.standard_normal(per_rank_shape, dtype=np.float32))
+    host = np.stack(rows).astype(jax.dtypes.canonicalize_dtype(dtype))
+    if op.input_kind == "per_peer":
+        # every rank sends a distinct chunk to every peer: [P, P, *shape]
+        host = np.stack([np.roll(host, r, axis=0) for r in range(num)])
+        # flatten per-rank slab trailing dims to [P, P, n] for flat payloads
+        if shape is None:
+            host = host.reshape(num, num, -1)
+    elif shape is None:
+        host = host.reshape(num, -1)
+    sharding = NamedSharding(mesh, _specs(mesh, axes, host.ndim))
+    return jax.device_put(host, sharding)

@@ -1,0 +1,107 @@
+"""Named tuning variants — the TPU analogue of the oneCCL tuning matrix.
+
+The reference steers collective algorithm/topology/fusion through env vars and
+re-edited module constants (``collectives/3d/launch_dsccl.sh:34-65``:
+``CCL_ALLREDUCE`` in {topo,direct,rabenseifner,nreduce,ring,double_tree,
+recursive_doubling,2d}, ``CCL_WORKER_COUNT``, ``CCL_FUSION*``,
+``CCL_ATL_TRANSPORT``), producing 19 result directories (SURVEY §2.3).
+
+On TPU the corresponding knobs are:
+
+- **mesh topology / axis order** — a 1D ring rides the ICI ring; a multi-axis
+  mesh makes XLA reduce hierarchically per axis (the "2d"/"topo" analogue);
+- **explicit hierarchical reduction** — ``allreduce_hierarchical`` psums one
+  axis at a time (ring-of-rings);
+- **XLA collective combiner thresholds** — the fusion analogue of
+  ``CCL_FUSION_BYTES_THRESHOLD``; these are process-level ``XLA_FLAGS``
+  (e.g. ``--xla_tpu_all_reduce_combine_threshold_bytes``) and must be set
+  before backend init, so variants carry them as metadata for launchers.
+
+Variants are first-class named configs (SURVEY §2.3 requirement: "named-variant
+config rather than edit-the-file"); the variant name lands in the result
+JSON's ``implementation`` field so stats curves stay comparable across runs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from dlbb_tpu.comm.mesh import MeshSpec
+
+
+@dataclass(frozen=True)
+class Variant:
+    """One named point in the tuning space."""
+
+    name: str
+    description: str = ""
+    # mesh shape override; None = flat ring of the sweep's rank count
+    mesh_shape: Optional[tuple[int, ...]] = None
+    mesh_axis_names: Optional[tuple[str, ...]] = None
+    # use the explicit per-axis hierarchical allreduce builder
+    hierarchical: bool = False
+    # XLA_FLAGS fragments a launcher must set before process start
+    xla_flags: tuple[str, ...] = ()
+    # extra metadata recorded into result JSON, as (key, value) pairs so the
+    # frozen dataclass stays hashable
+    extra: tuple[tuple[str, str], ...] = ()
+
+    def mesh_spec(self, num_ranks: int) -> MeshSpec:
+        if self.mesh_shape is not None:
+            import math
+
+            if math.prod(self.mesh_shape) != num_ranks:
+                raise ValueError(
+                    f"variant {self.name!r} mesh {self.mesh_shape} does not "
+                    f"cover {num_ranks} ranks"
+                )
+            names = self.mesh_axis_names or tuple(
+                f"ax{i}" for i in range(len(self.mesh_shape))
+            )
+            return MeshSpec(self.mesh_shape, names)
+        return MeshSpec.ring(num_ranks)
+
+
+VARIANTS: dict[str, Variant] = {
+    "default": Variant(
+        "default",
+        "flat 1D ring mesh, XLA-chosen reduction (analogue of CCL topo default)",
+    ),
+    "ring": Variant(
+        "ring",
+        "flat 1D ring mesh — explicit analogue of CCL_ALLREDUCE=ring",
+    ),
+    "grid2x2x2": Variant(
+        "grid2x2x2",
+        "2x2x2 mesh, joint reduction over all axes (CCL_ALLREDUCE=2d analogue; "
+        "BASELINE.json config 3)",
+        mesh_shape=(2, 2, 2),
+        mesh_axis_names=("x", "y", "z"),
+    ),
+    "hier2x2x2": Variant(
+        "hier2x2x2",
+        "2x2x2 mesh, explicit per-axis hierarchical psum (ICI ring-of-rings, "
+        "double_tree/rabenseifner analogue)",
+        mesh_shape=(2, 2, 2),
+        mesh_axis_names=("x", "y", "z"),
+        hierarchical=True,
+    ),
+    "combine4mb": Variant(
+        "combine4mb",
+        "all-reduce combiner threshold 4 MiB (CCL_FUSION_BYTES_THRESHOLD analogue)",
+        xla_flags=("--xla_tpu_all_reduce_combine_threshold_bytes=4194304",),
+    ),
+    "combine128mb": Variant(
+        "combine128mb",
+        "all-reduce combiner threshold 128 MiB",
+        xla_flags=("--xla_tpu_all_reduce_combine_threshold_bytes=134217728",),
+    ),
+}
+
+
+def get_variant(name: str) -> Variant:
+    try:
+        return VARIANTS[name]
+    except KeyError:
+        raise KeyError(f"unknown variant {name!r}; known: {sorted(VARIANTS)}") from None

@@ -1,0 +1,58 @@
+"""Test fixtures: CPU-simulated 8-device mesh.
+
+The reference tests "multi-node without a cluster" by running N ranks on one
+box under mpirun/torchrun (SURVEY §4).  The JAX analogue is
+``--xla_force_host_platform_device_count=8``: eight fake CPU devices in one
+process.  Env must be set before jax initialises a backend, hence module
+top-level, before any dlbb_tpu import.
+"""
+
+import os
+
+# Force CPU: the session env pins JAX_PLATFORMS to the real TPU platform, but
+# tests run on the simulated multi-device mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The image's TPU plugin overrides jax_platforms at import time (sitecustomize);
+# force the config back to CPU before any backend is initialised.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from dlbb_tpu.comm import MeshSpec, build_mesh  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 simulated devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    """Flat 8-rank ring mesh."""
+    return build_mesh(MeshSpec.ring(8))
+
+
+@pytest.fixture(scope="session")
+def mesh4(devices):
+    return build_mesh(MeshSpec.ring(4))
+
+
+@pytest.fixture(scope="session")
+def mesh2x4(devices):
+    """Multi-axis mesh for hierarchical collectives / dp x tp models."""
+    return build_mesh(MeshSpec.grid((2, 4), ("dp", "tp")))
+
+
+@pytest.fixture(scope="session")
+def mesh2x2x2(devices):
+    return build_mesh(MeshSpec.grid((2, 2, 2), ("x", "y", "z")))
