@@ -1,0 +1,29 @@
+"""Benchmark harness (L4/L5 replacement).
+
+One declarative driver replaces the reference's eight near-identical
+per-backend scripts (``collectives/{1d,3d}/{openmpi,intelmpi,dsgloo,dsccl}.py``
+— SURVEY §1: "factor this duplicated skeleton into one harness with pluggable
+collectives").
+"""
+
+from dlbb_tpu.bench.runner import (
+    DATA_SIZES_1D,
+    EXTENDED_DATA_SIZES_1D,
+    GRID_3D,
+    OPERATIONS_1D,
+    OPERATIONS_3D,
+    Sweep1D,
+    Sweep3D,
+    run_sweep,
+)
+
+__all__ = [
+    "Sweep1D",
+    "Sweep3D",
+    "run_sweep",
+    "DATA_SIZES_1D",
+    "EXTENDED_DATA_SIZES_1D",
+    "GRID_3D",
+    "OPERATIONS_1D",
+    "OPERATIONS_3D",
+]

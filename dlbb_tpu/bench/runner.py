@@ -1,0 +1,319 @@
+"""Unified collective-benchmark driver.
+
+Replaces the duplicated skeleton of the reference's benchmark scripts
+(constants → init → per-(op,size) loop of {warmup, timed measurement, gather,
+JSON dump}; e.g. ``collectives/1d/openmpi.py:204-300``,
+``collectives/3d/dsccl.py:120-241``) with one driver over declarative sweep
+configs.  "Which backend executes the collective" — the reference's
+MPI/Gloo/oneCCL axis — becomes a named :class:`~dlbb_tpu.comm.variants.Variant`
+(mesh topology / reduction strategy / combiner flags), recorded in the result
+JSON's implementation field so stats curves stay comparable.
+
+Timing semantics (SURVEY §7 "hard parts"): each op is a jitted shard_map
+micro-program; warmup absorbs XLA compilation; each timed iteration is
+``perf_counter``-bracketed ``fn(x).block_until_ready()`` — the async-dispatch
+analogue of ``comm.Barrier(); MPI.Wtime(); op; Wtime()``
+(``collectives/1d/openmpi.py:60-66``).
+
+Result JSON schema is reference-compatible: the 1D stats reader accepts
+``implementation`` (``collectives/1d/stats.py:167``), and field names /
+filenames match ``collectives/1d/openmpi.py:273-295`` and
+``collectives/3d/openmpi.py:205-233``.
+"""
+
+from __future__ import annotations
+
+import time
+import traceback
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dlbb_tpu.comm.mesh import build_mesh
+from dlbb_tpu.comm.ops import (
+    build_allreduce_hierarchical,
+    get_op,
+    make_payload,
+)
+from dlbb_tpu.comm.variants import Variant, get_variant
+from dlbb_tpu.utils.config import save_json
+from dlbb_tpu.utils.metrics import time_fn
+from dlbb_tpu.utils.sysinfo import collect_system_info
+
+# Reference 1D sweep constants (``collectives/1d/openmpi.py:14-49``).
+# NOTE the reference's size labels are 2x the actual fp16 payload
+# ("16MB" = 4,194,304 elements x 2 B = 8 MiB — BASELINE.md); labels are kept
+# verbatim for curve comparability, with honest byte counts in the JSON.
+DATA_SIZES_1D: dict[str, int] = {
+    "1KB": 256,
+    "64KB": 16384,
+    "1MB": 262144,
+    "16MB": 4194304,
+}
+
+# Extension to the north-star 1 KB–1 GB curve (BASELINE.json metric).
+EXTENDED_DATA_SIZES_1D: dict[str, int] = {
+    **DATA_SIZES_1D,
+    "64MB": 16777216,
+    "256MB": 67108864,
+    "1GB": 268435456,
+}
+
+OPERATIONS_1D: tuple[str, ...] = (
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "gather",
+    "scatter",
+    "reduce",
+    "alltoall",
+    "sendrecv",
+)
+
+# Reference 3D sweep grid (``collectives/3d/openmpi.py:19-31``).
+OPERATIONS_3D: tuple[str, ...] = (
+    "allreduce",
+    "allgather",
+    "broadcast",
+    "gather",
+    "reduce",
+)
+GRID_3D: dict[str, Sequence[int]] = {
+    "batch_sizes": (1, 8, 16, 32),
+    "seq_lengths": (1, 2048, 4096, 8192),
+    "hidden_dims": (2048, 4096),
+}
+
+
+@dataclass(frozen=True)
+class Sweep1D:
+    """1D collective microbenchmark sweep (flat element-count payloads)."""
+
+    implementation: str = "xla_tpu"
+    variant: str = "default"
+    operations: tuple[str, ...] = OPERATIONS_1D
+    data_sizes: tuple[tuple[str, int], ...] = tuple(DATA_SIZES_1D.items())
+    rank_counts: tuple[int, ...] = (2, 4, 8)
+    dtype: str = "bfloat16"
+    warmup_iterations: int = 10
+    measurement_iterations: int = 100
+    output_dir: str = "results/1d"
+    root: int = 0
+
+    kind: str = "1d"
+
+
+@dataclass(frozen=True)
+class Sweep3D:
+    """3D LLM-shaped tensor collective sweep over (batch, seq, hidden)."""
+
+    implementation: str = "xla_tpu"
+    variant: str = "default"
+    operations: tuple[str, ...] = OPERATIONS_3D
+    batch_sizes: tuple[int, ...] = tuple(GRID_3D["batch_sizes"])
+    seq_lengths: tuple[int, ...] = tuple(GRID_3D["seq_lengths"])
+    hidden_dims: tuple[int, ...] = tuple(GRID_3D["hidden_dims"])
+    rank_counts: tuple[int, ...] = (4, 8)
+    dtype: str = "bfloat16"
+    warmup_iterations: int = 10
+    measurement_iterations: int = 100
+    output_dir: str = "results/3d"
+    root: int = 0
+
+    kind: str = "3d"
+
+
+def _dtype_of(name: str):
+    return {
+        "bfloat16": jnp.bfloat16,
+        "float16": jnp.float16,
+        "float32": jnp.float32,
+    }[name]
+
+
+def _impl_name(sweep) -> str:
+    if sweep.variant and sweep.variant != "default":
+        return f"{sweep.implementation}_{sweep.variant}"
+    return sweep.implementation
+
+
+def _gather_timings(local: list[float]) -> list[list[float]]:
+    """Per-host × per-iteration timings, shaped like the reference's
+    ``[rank][iteration]`` gather (``collectives/1d/openmpi.py:270``).
+
+    Single-process (incl. the CPU-simulated mesh): one timing stream for the
+    whole SPMD program — the schema keeps the 2D shape with one row.
+    Multi-host: each host contributes its own dispatch timings via a host-side
+    allgather, so load-imbalance across hosts is still computable.
+    """
+    if jax.process_count() == 1:
+        return [local]
+    from jax.experimental import multihost_utils
+
+    arr = multihost_utils.process_allgather(np.asarray(local, dtype=np.float64))
+    return np.asarray(arr).reshape(jax.process_count(), -1).tolist()
+
+
+def _check_variant_flags(variant: Variant) -> None:
+    """XLA flags (combiner thresholds etc.) are process-start options: they
+    must already be in ``XLA_FLAGS`` before backend init.  Refuse to run —
+    rather than silently mislabel results — if a flag variant was requested
+    without its flags set (they are the launcher's job, see
+    ``launch/launch_tpu_pod.sh``)."""
+    import os
+
+    missing = [f for f in variant.xla_flags if f not in os.environ.get("XLA_FLAGS", "")]
+    if missing:
+        raise RuntimeError(
+            f"variant {variant.name!r} requires XLA_FLAGS to contain "
+            f"{missing}; relaunch the process with them set (process-start "
+            "option; cannot be applied after backend init)"
+        )
+
+
+def _build_fn(op_name: str, variant: Variant, mesh, axes, root: int):
+    if op_name == "allreduce" and variant.hierarchical:
+        return build_allreduce_hierarchical(mesh, axes, root)
+    return get_op(op_name).build(mesh, axes, root)
+
+
+def run_sweep(
+    sweep: Sweep1D | Sweep3D,
+    devices: Optional[Sequence] = None,
+    verbose: bool = True,
+) -> list[Path]:
+    """Run a full sweep, writing one reference-schema JSON per config.
+
+    Per-config failures are caught, reported, and skipped so one failing
+    combination doesn't kill the sweep (reference
+    ``collectives/1d/openmpi.py:253-267``).
+    """
+    variant = get_variant(sweep.variant)
+    _check_variant_flags(variant)
+    impl = _impl_name(sweep)
+    out_dir = Path(sweep.output_dir)
+    written: list[Path] = []
+    sysinfo = collect_system_info()
+    n_avail = len(devices) if devices is not None else len(jax.devices())
+
+    for num_ranks in sweep.rank_counts:
+        if num_ranks > n_avail:
+            if verbose:
+                print(
+                    f"[skip] {num_ranks} ranks > {n_avail} devices available"
+                )
+            continue
+        try:
+            spec = variant.mesh_spec(num_ranks)
+            mesh = build_mesh(spec, devices=devices)
+        except ValueError as e:
+            # e.g. fixed-shape variant (2x2x2) asked for an incompatible rank
+            # count — skip this rank count, keep sweeping (parity with the
+            # reference's per-config error-skip, collectives/1d/openmpi.py:253)
+            if verbose:
+                print(f"[skip] ranks={num_ranks}: {e}")
+            continue
+        axes = spec.axis_names
+        for config in _iter_configs(sweep):
+            try:
+                path = _run_one(
+                    sweep, variant, impl, mesh, axes, num_ranks, config,
+                    out_dir, sysinfo, verbose,
+                )
+                written.append(path)
+            except Exception as e:  # noqa: BLE001 — sweep resilience
+                if verbose:
+                    print(f"[error] {impl} {config}: {e}")
+                    traceback.print_exc()
+                continue
+    return written
+
+
+def _iter_configs(sweep):
+    if sweep.kind == "1d":
+        for op in sweep.operations:
+            for label, n in sweep.data_sizes:
+                yield {"operation": op, "size_label": label, "num_elements": n}
+    else:
+        for op in sweep.operations:
+            for b in sweep.batch_sizes:
+                for s in sweep.seq_lengths:
+                    for h in sweep.hidden_dims:
+                        yield {
+                            "operation": op,
+                            "batch": b,
+                            "seq_len": s,
+                            "hidden_dim": h,
+                        }
+
+
+def _run_one(
+    sweep, variant, impl, mesh, axes, num_ranks, config, out_dir, sysinfo,
+    verbose,
+) -> Path:
+    op_name = config["operation"]
+    op = get_op(op_name)
+    dtype = _dtype_of(sweep.dtype)
+    elem_bytes = jnp.dtype(dtype).itemsize
+
+    if sweep.kind == "1d":
+        num_elements = config["num_elements"]
+        payload_shape = None
+    else:
+        payload_shape = (config["batch"], config["seq_len"], config["hidden_dim"])
+        num_elements = int(np.prod(payload_shape))
+
+    x = make_payload(
+        op, mesh, axes, num_elements, dtype=dtype, shape=payload_shape
+    )
+    fn = _build_fn(op_name, variant, mesh, axes, sweep.root)
+
+    local = time_fn(
+        fn, x,
+        warmup=sweep.warmup_iterations,
+        iterations=sweep.measurement_iterations,
+    )
+    timings = _gather_timings(local)
+
+    result: dict[str, Any] = {
+        "implementation": impl,
+        "mpi_implementation": impl,  # legacy key the 1D stats reader prefers
+        "operation": op_name,
+        "num_ranks": num_ranks,
+        "num_elements": num_elements,
+        "dtype": sweep.dtype,
+        "warmup_iterations": sweep.warmup_iterations,
+        "measurement_iterations": sweep.measurement_iterations,
+        "timing_method": "time.perf_counter() + jax.block_until_ready()",
+        "timings": timings,
+        "variant": variant.name,
+        **dict(variant.extra),
+        "mesh_shape": list(mesh.devices.shape),
+        "mesh_axis_names": list(mesh.axis_names),
+        "payload_bytes_per_rank": num_elements * elem_bytes,
+        "timestamp": time.time(),
+        "system_info": sysinfo,
+    }
+
+    if sweep.kind == "1d":
+        label = config["size_label"]
+        result["data_size_name"] = label
+        fname = f"{impl}_{op_name}_ranks{num_ranks}_{label}.json"
+    else:
+        b, s, h = config["batch"], config["seq_len"], config["hidden_dim"]
+        tensor_size_bytes = num_elements * 2  # reported as-bf16, like the
+        # reference (``collectives/3d/openmpi.py:167-168``)
+        result["tensor_shape"] = {"batch": b, "seq_len": s, "hidden_dim": h}
+        result["tensor_size_bytes"] = tensor_size_bytes
+        result["tensor_size_mb"] = tensor_size_bytes / 2**20
+        fname = f"{impl}_{op_name}_ranks{num_ranks}_b{b}_s{s}_h{h}.json"
+
+    path = save_json(result, out_dir / fname)
+    if verbose:
+        mean_ms = float(np.mean(timings)) * 1e3
+        print(f"  [{impl}] {fname}: mean {mean_ms:.3f} ms")
+    return path

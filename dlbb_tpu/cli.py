@@ -1,0 +1,192 @@
+"""Command-line interface.
+
+Replaces the reference's launch layer (L7): ``mpirun -np N python
+collectives/1d/openmpi.py`` with edit-the-file constants becomes
+``python -m dlbb_tpu.cli bench1d --ranks 2 4 8 --variant ring``; the
+rank-count sweep loops of ``collectives/launch_{openmpi,intelmpi,dsccl}.sh``
+become the ``--ranks`` flag; the CCL_* env tuning matrix becomes
+``--variant`` (see ``dlbb_tpu.comm.variants``).
+
+``--simulate N`` stands up the N-device CPU-simulated mesh (the dev path,
+analogue of running N ranks on localhost) — it must act before the JAX
+backend initialises, which is why it is handled first in ``main``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--impl", default="xla_tpu", help="implementation name recorded in results")
+    p.add_argument("--variant", default="default", help="named tuning variant")
+    p.add_argument("--ranks", type=int, nargs="+", default=None, help="rank counts to sweep")
+    p.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float16", "float32"])
+    p.add_argument("--warmup", type=int, default=10)
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--output", default=None, help="output directory for result JSONs")
+    p.add_argument("--simulate", type=int, default=0, metavar="N",
+                   help="use an N-device CPU-simulated mesh (dev path)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="dlbb_tpu", description=__doc__)
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    b1 = sub.add_parser("bench1d", help="1D collective microbenchmark sweep")
+    _add_common(b1)
+    b1.add_argument("--ops", nargs="+", default=None, help="collectives to benchmark")
+    b1.add_argument("--sizes", nargs="+", default=None,
+                    help="size labels (1KB 64KB 1MB 16MB 64MB 256MB 1GB) or 'extended'")
+
+    b3 = sub.add_parser("bench3d", help="3D (batch, seq, hidden) tensor collective sweep")
+    _add_common(b3)
+    b3.add_argument("--ops", nargs="+", default=None)
+    b3.add_argument("--batch", type=int, nargs="+", default=None)
+    b3.add_argument("--seq", type=int, nargs="+", default=None)
+    b3.add_argument("--hidden", type=int, nargs="+", default=None)
+
+    s1 = sub.add_parser("stats1d", help="process 1D result JSONs to stats + CSV")
+    s1.add_argument("--input", required=True)
+    s1.add_argument("--output", required=True)
+    s1.add_argument("--algorithm-bandwidth", action="store_true",
+                    help="use per-op bus-bandwidth factors instead of the "
+                         "reference's uniform formula")
+
+    s3 = sub.add_parser("stats3d", help="process 3D result JSONs to standard+transposed CSVs")
+    s3.add_argument("--input", required=True)
+    s3.add_argument("--output", required=True)
+    s3.add_argument("--impl", default="xla_tpu")
+
+    e2 = sub.add_parser("e2e", help="end-to-end TP transformer forward benchmark")
+    e2.add_argument("--config", required=True, help="YAML experiment config")
+    e2.add_argument("--simulate", type=int, default=0, metavar="N")
+    e2.add_argument("--output", default=None)
+
+    tr = sub.add_parser("train", help="DDP/ZeRO-1 training-loop benchmark")
+    tr.add_argument("--config", required=True, help="YAML experiment config")
+    tr.add_argument("--simulate", type=int, default=0, metavar="N")
+    tr.add_argument("--zero1", action="store_true", help="shard optimizer state (ZeRO-1)")
+    tr.add_argument("--output", default=None)
+
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "simulate", 0):
+        from dlbb_tpu.utils.simulate import force_cpu_simulation
+
+        force_cpu_simulation(args.simulate)
+
+    if getattr(args, "variant", None) is not None:
+        from dlbb_tpu.comm.variants import get_variant
+
+        try:
+            get_variant(args.variant)
+        except KeyError as e:
+            print(f"error: {e.args[0]}")
+            return 2
+
+    if args.cmd == "bench1d":
+        from dlbb_tpu.bench import (
+            DATA_SIZES_1D,
+            EXTENDED_DATA_SIZES_1D,
+            OPERATIONS_1D,
+            Sweep1D,
+            run_sweep,
+        )
+
+        if args.sizes == ["extended"]:
+            sizes = tuple(EXTENDED_DATA_SIZES_1D.items())
+        elif args.sizes:
+            table = EXTENDED_DATA_SIZES_1D
+            unknown = [s for s in args.sizes if s not in table]
+            if unknown:
+                print(f"unknown size labels {unknown}; known: {list(table)}")
+                return 2
+            sizes = tuple((s, table[s]) for s in args.sizes)
+        else:
+            sizes = tuple(DATA_SIZES_1D.items())
+        sweep = Sweep1D(
+            implementation=args.impl,
+            variant=args.variant,
+            operations=tuple(args.ops) if args.ops else OPERATIONS_1D,
+            data_sizes=sizes,
+            rank_counts=tuple(args.ranks) if args.ranks else (2, 4, 8),
+            dtype=args.dtype,
+            warmup_iterations=args.warmup,
+            measurement_iterations=args.iters,
+            output_dir=args.output or "results/1d",
+        )
+        files = run_sweep(sweep)
+        print(f"wrote {len(files)} result files to {sweep.output_dir}")
+        return 0
+
+    if args.cmd == "bench3d":
+        from dlbb_tpu.bench import GRID_3D, OPERATIONS_3D, Sweep3D, run_sweep
+
+        sweep = Sweep3D(
+            implementation=args.impl,
+            variant=args.variant,
+            operations=tuple(args.ops) if args.ops else OPERATIONS_3D,
+            batch_sizes=tuple(args.batch) if args.batch else tuple(GRID_3D["batch_sizes"]),
+            seq_lengths=tuple(args.seq) if args.seq else tuple(GRID_3D["seq_lengths"]),
+            hidden_dims=tuple(args.hidden) if args.hidden else tuple(GRID_3D["hidden_dims"]),
+            rank_counts=tuple(args.ranks) if args.ranks else (4, 8),
+            dtype=args.dtype,
+            warmup_iterations=args.warmup,
+            measurement_iterations=args.iters,
+            output_dir=args.output or "results/3d",
+        )
+        files = run_sweep(sweep)
+        print(f"wrote {len(files)} result files to {sweep.output_dir}")
+        return 0
+
+    if args.cmd == "stats1d":
+        from dlbb_tpu.stats import process_1d_results
+
+        results = process_1d_results(
+            args.input, args.output,
+            algorithm_bandwidth=args.algorithm_bandwidth,
+        )
+        print(f"processed {len(results)} result files")
+        return 0
+
+    if args.cmd == "stats3d":
+        from dlbb_tpu.stats import process_3d_results
+
+        results = process_3d_results(args.input, args.output, args.impl)
+        print(f"processed {len(results)} result files")
+        return 0
+
+    if args.cmd == "e2e":
+        try:
+            from dlbb_tpu.bench.e2e import run_e2e_from_config
+        except ImportError:
+            print("error: the e2e benchmark module is not available in this build")
+            return 2
+
+        result = run_e2e_from_config(args.config, output_dir=args.output)
+        print(f"forward mean {result['forward_time']['mean'] * 1e3:.2f} ms")
+        return 0
+
+    if args.cmd == "train":
+        try:
+            from dlbb_tpu.train.loop import run_train_from_config
+        except ImportError:
+            print("error: the train module is not available in this build")
+            return 2
+
+        result = run_train_from_config(
+            args.config, zero1=args.zero1, output_dir=args.output
+        )
+        print(f"step mean {result['step_time']['mean'] * 1e3:.2f} ms")
+        return 0
+
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

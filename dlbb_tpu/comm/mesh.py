@@ -133,23 +133,29 @@ def initialize_distributed(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
     process_id: Optional[int] = None,
+    auto: bool = False,
 ) -> DistributedContext:
     """Multi-host bootstrap — the TPU-pod analogue of ``mpirun`` +
     ``MPI.COMM_WORLD`` (reference ``run_mpi.py:29-43``) and of the DeepSpeed
     launcher env handshake (``collectives/3d/launch_dsccl.sh:69-74``).
 
-    On a TPU pod slice, ``jax.distributed.initialize()`` with no arguments
-    auto-discovers coordinator/processes from the TPU metadata server.  On a
-    single host (including the CPU-simulated mesh) this is a no-op.
+    Three modes:
+    - explicit args → ``jax.distributed.initialize`` with them;
+    - ``auto=True`` (what pod launchers pass — ``launch/launch_tpu_pod.sh``) →
+      argument-free ``jax.distributed.initialize()``, which auto-discovers
+      coordinator/processes from the TPU metadata server;
+    - no args, ``auto=False`` (the default) → single-host no-op, so library
+      users on one host or the CPU-simulated mesh never touch the
+      coordinator handshake.
     """
-    if num_processes is not None and num_processes > 1:
+    if num_processes is not None or coordinator_address is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
-    elif coordinator_address is not None:
-        jax.distributed.initialize(coordinator_address=coordinator_address)
+    elif auto:
+        jax.distributed.initialize()
     return DistributedContext(
         process_id=jax.process_index(),
         num_processes=jax.process_count(),

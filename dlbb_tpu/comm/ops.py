@@ -289,18 +289,22 @@ def make_payload(
     """
     num = mesh_num_ranks(mesh, axes)
     per_rank_shape = tuple(shape) if shape is not None else (num_elements,)
-    rows = []
+    target = jax.dtypes.canonicalize_dtype(dtype)
+    # Generate row-by-row in the target dtype: peak host memory stays at the
+    # payload size itself (float32 staging is per-row only), which matters
+    # for the 1 GB-label sweeps.
+    rows = np.empty((num,) + per_rank_shape, dtype=target)
     for rank in range(num):
         rng = np.random.default_rng(seed + rank)
-        rows.append(rng.standard_normal(per_rank_shape, dtype=np.float32))
-    host = np.stack(rows).astype(jax.dtypes.canonicalize_dtype(dtype))
+        rows[rank] = rng.standard_normal(per_rank_shape, dtype=np.float32)
     if op.input_kind == "per_peer":
-        # every rank sends a distinct chunk to every peer: [P, P, *shape]
-        host = np.stack([np.roll(host, r, axis=0) for r in range(num)])
-        # flatten per-rank slab trailing dims to [P, P, n] for flat payloads
-        if shape is None:
-            host = host.reshape(num, num, -1)
-    elif shape is None:
-        host = host.reshape(num, -1)
+        # every rank sends a distinct chunk to every peer: [P, P, *shape];
+        # slab r is the rank rows cyclically shifted by r
+        host = np.empty((num,) + rows.shape, dtype=target)
+        idx = np.arange(num)
+        for r in range(num):
+            host[r] = rows[(idx - r) % num]
+    else:
+        host = rows
     sharding = NamedSharding(mesh, _specs(mesh, axes, host.ndim))
     return jax.device_put(host, sharding)

@@ -1,0 +1,21 @@
+"""Offline statistics pipeline (L6 replacement).
+
+Reference-compatible schemas: 1D per-file ``*_stats.json`` + consolidated CSV
+(``collectives/1d/stats.py``), 3D standard + transposed CSVs
+(``collectives/3d/stats.py``).  Bit-compatible columns matter more than
+elegance (SURVEY §7 step 3) — this is the judged artifact format.
+"""
+
+from dlbb_tpu.stats.stats1d import (
+    calculate_bandwidth,
+    calculate_statistics,
+    process_1d_results,
+)
+from dlbb_tpu.stats.stats3d import process_3d_results
+
+__all__ = [
+    "calculate_statistics",
+    "calculate_bandwidth",
+    "process_1d_results",
+    "process_3d_results",
+]

@@ -1,0 +1,126 @@
+"""3D tensor-benchmark statistics.
+
+Schema parity with reference ``collectives/3d/stats.py``: ms-scale stats
+(mean/median/min/max only, :32-49), a standard CSV (one row per config,
+columns :151-164) and a transposed CSV (metrics as rows, config-id columns
+``op_rX_hX_sX_bX``, metadata block appended, :187-282), both sorted
+operation → ranks → hidden_dim → seq_len → batch (:167-173).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+STANDARD_COLUMNS = [
+    "implementation",
+    "operation",
+    "num_ranks",
+    "hidden_dim",
+    "seq_len",
+    "batch",
+    "tensor_size_mb",
+    "num_elements",
+    "mean_time_ms",
+    "median_time_ms",
+    "min_time_ms",
+    "max_time_ms",
+]
+
+METRICS = ["mean_time_ms", "median_time_ms", "min_time_ms", "max_time_ms"]
+
+_SORT_KEY = lambda r: (  # noqa: E731
+    r["operation"], r["num_ranks"], r["hidden_dim"], r["seq_len"], r["batch"],
+)
+
+
+def calculate_statistics_3d(timings_2d: list[list[float]]) -> dict[str, float]:
+    flat = np.asarray(timings_2d, dtype=np.float64).ravel()
+    return {
+        "mean_time_ms": float(flat.mean() * 1e3),
+        "median_time_ms": float(np.median(flat) * 1e3),
+        "min_time_ms": float(flat.min() * 1e3),
+        "max_time_ms": float(flat.max() * 1e3),
+    }
+
+
+def process_3d_results(
+    input_dir: str | Path,
+    output_dir: str | Path,
+    implementation: str = "xla_tpu",
+    verbose: bool = True,
+) -> list[dict[str, Any]]:
+    """Process 3D result JSONs → standard + transposed CSVs + summary JSON.
+
+    ``implementation`` names the output files, replacing the reference's
+    edit-the-constant switch (``collectives/3d/stats.py:17``).
+    """
+    input_dir, output_dir = Path(input_dir), Path(output_dir)
+    output_dir.mkdir(parents=True, exist_ok=True)
+
+    results: list[dict[str, Any]] = []
+    for json_file in sorted(input_dir.glob("*.json")):
+        if json_file.name.endswith("_stats.json"):
+            continue
+        try:
+            with open(json_file) as f:
+                data = json.load(f)
+            shape = data["tensor_shape"]
+            results.append(
+                {
+                    "implementation": data.get("implementation")
+                    or data.get("mpi_implementation")
+                    or implementation,
+                    "operation": data["operation"],
+                    "num_ranks": data["num_ranks"],
+                    "hidden_dim": shape["hidden_dim"],
+                    "seq_len": shape["seq_len"],
+                    "batch": shape["batch"],
+                    "tensor_size_mb": data["tensor_size_mb"],
+                    "num_elements": data["num_elements"],
+                    **calculate_statistics_3d(data["timings"]),
+                }
+            )
+        except Exception as e:  # noqa: BLE001 — per-file resilience
+            if verbose:
+                print(f"  ERROR processing {json_file.name}: {e}")
+            continue
+
+    if not results:
+        return results
+    results.sort(key=_SORT_KEY)
+
+    std_path = output_dir / f"benchmark_statistics_3d_{implementation}_standard.csv"
+    with open(std_path, "w", newline="") as f:
+        writer = csv.DictWriter(f, fieldnames=STANDARD_COLUMNS)
+        writer.writeheader()
+        for r in results:
+            writer.writerow({k: r[k] for k in STANDARD_COLUMNS})
+
+    tr_path = output_dir / f"benchmark_statistics_3d_{implementation}_transpose.csv"
+    config_ids = [
+        f"{r['operation']}_r{r['num_ranks']}_h{r['hidden_dim']}"
+        f"_s{r['seq_len']}_b{r['batch']}"
+        for r in results
+    ]
+    with open(tr_path, "w", newline="") as f:
+        writer = csv.writer(f)
+        writer.writerow(["Metric"] + config_ids)
+        for metric in METRICS:
+            writer.writerow([metric] + [r[metric] for r in results])
+        writer.writerow([])
+        writer.writerow(["--- Metadata ---"])
+        for meta in (
+            "operation", "num_ranks", "hidden_dim", "seq_len", "batch",
+            "tensor_size_mb",
+        ):
+            writer.writerow([meta] + [r[meta] for r in results])
+
+    if verbose:
+        print(f"Standard CSV saved: {std_path}")
+        print(f"Transposed CSV saved: {tr_path}")
+    return results

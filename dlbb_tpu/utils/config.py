@@ -1,0 +1,47 @@
+"""Config / JSON IO (reference ``utils.py:90-102`` load_config,
+``utils.py:268-279`` save_results)."""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Any
+
+import yaml
+
+
+def load_config(path: str | Path) -> dict[str, Any]:
+    """Load a YAML experiment config (schema: ``configs/baseline_config.yaml``,
+    mirroring reference ``config/baseline_config.yaml:1-34``)."""
+    path = Path(path)
+    if not path.exists():
+        raise FileNotFoundError(f"config file not found: {path}")
+    with open(path) as f:
+        cfg = yaml.safe_load(f)
+    if not isinstance(cfg, dict):
+        raise ValueError(f"config {path} did not parse to a mapping")
+    return cfg
+
+
+def save_json(data: dict[str, Any], path: str | Path) -> Path:
+    """Write a result dict as pretty JSON, creating parent dirs."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(data, f, indent=2, default=_jsonify)
+    return path
+
+
+def _jsonify(obj: Any):
+    import numpy as np
+
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, os.PathLike):
+        return str(obj)
+    raise TypeError(f"not JSON serialisable: {type(obj)}")

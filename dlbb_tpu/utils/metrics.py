@@ -1,0 +1,98 @@
+"""Metrics collection and timing.
+
+Parity with reference ``utils.py:17-87``: ``MetricsCollector`` (named timing
+series → summary with mean/std/min/max/median/p95/p99) and a ``Timer`` context
+manager — but timing here is ``time.perf_counter`` bracketed by
+``jax.block_until_ready``, because under XLA's async dispatch a wall-clock
+timer without a device sync measures dispatch latency, not execution
+(SURVEY §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+
+def summarize(values: list[float]) -> dict[str, float]:
+    """Summary statistics over a timing series (seconds), matching the
+    reference's metric names (``utils.py:43-66``)."""
+    arr = np.asarray(values, dtype=np.float64)
+    if arr.size == 0:
+        return {}
+    return {
+        "mean": float(arr.mean()),
+        "std": float(arr.std()),
+        "min": float(arr.min()),
+        "max": float(arr.max()),
+        "median": float(np.median(arr)),
+        "p95": float(np.percentile(arr, 95)),
+        "p99": float(np.percentile(arr, 99)),
+        "count": int(arr.size),
+    }
+
+
+class MetricsCollector:
+    """Named timing series with summaries (reference ``utils.py:17-70``)."""
+
+    def __init__(self) -> None:
+        self._series: dict[str, list[float]] = {}
+        self._scalars: dict[str, Any] = {}
+
+    def record(self, name: str, value: float) -> None:
+        self._series.setdefault(name, []).append(float(value))
+
+    def record_scalar(self, name: str, value: Any) -> None:
+        self._scalars[name] = value
+
+    def series(self, name: str) -> list[float]:
+        return list(self._series.get(name, []))
+
+    def summary(self) -> dict[str, Any]:
+        out: dict[str, Any] = dict(self._scalars)
+        for name, vals in self._series.items():
+            out[name] = summarize(vals)
+        return out
+
+
+class Timer:
+    """Context-manager wall timer (reference ``utils.py:73-87``), with an
+    optional result to synchronise on before stopping the clock."""
+
+    def __init__(self, sync: Optional[Any] = None) -> None:
+        self._sync = sync
+        self.elapsed: float = float("nan")
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._sync is not None:
+            import jax
+
+            jax.block_until_ready(self._sync)
+        self.elapsed = time.perf_counter() - self._start
+
+
+def time_fn(fn, *args, warmup: int, iterations: int) -> list[float]:
+    """Benchmark a jitted function: ``warmup`` calls absorb compilation (the
+    analogue of the reference's warmup loops, which absorbed page-faults —
+    ``collectives/1d/openmpi.py:253-259``), then ``iterations`` timed calls,
+    each bracketed by ``block_until_ready`` (the barrier analogue of
+    ``comm.Barrier(); MPI.Wtime()`` at ``collectives/1d/openmpi.py:60-66``).
+
+    Returns per-iteration wall times in seconds.
+    """
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    timings = []
+    for _ in range(iterations):
+        start = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        timings.append(time.perf_counter() - start)
+    return timings

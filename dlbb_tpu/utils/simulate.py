@@ -1,0 +1,33 @@
+"""CPU-simulated multi-device mesh setup — the dev-path analogue of
+``mpirun -np N`` on localhost (SURVEY §4).
+
+Must run before the JAX backend initialises.  Two steps are required on this
+image: the ``xla_force_host_platform_device_count`` flag, and forcing the
+platform back to CPU via *config* — the TPU plugin's sitecustomize overrides
+the ``JAX_PLATFORMS`` env var at import time, so the env alone is ignored.
+
+Shared by the CLI (``--simulate N``) and ``tests/conftest.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu_simulation(num_devices: int) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={num_devices}",
+            flags,
+        )
+    else:
+        flags = f"{flags} --xla_force_host_platform_device_count={num_devices}"
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

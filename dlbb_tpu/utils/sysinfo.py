@@ -1,0 +1,32 @@
+"""System / device info for result provenance (reference
+``utils.py:132-151`` collect_system_info: platform + psutil + torch versions;
+here: platform + JAX + device topology)."""
+
+from __future__ import annotations
+
+import platform
+from typing import Any
+
+
+def collect_system_info() -> dict[str, Any]:
+    import jax
+
+    devices = jax.devices()
+    info: dict[str, Any] = {
+        "platform": platform.platform(),
+        "python_version": platform.python_version(),
+        "processor": platform.processor(),
+        "jax_version": jax.__version__,
+        "backend": jax.default_backend(),
+        "num_devices": len(devices),
+        "num_processes": jax.process_count(),
+        "device_kind": devices[0].device_kind if devices else "none",
+    }
+    try:
+        import psutil
+
+        info["cpu_count"] = psutil.cpu_count()
+        info["memory_gb"] = round(psutil.virtual_memory().total / 2**30, 2)
+    except ImportError:
+        pass
+    return info

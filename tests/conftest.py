@@ -7,23 +7,11 @@ process.  Env must be set before jax initialises a backend, hence module
 top-level, before any dlbb_tpu import.
 """
 
-import os
+from dlbb_tpu.utils.simulate import force_cpu_simulation
 
-# Force CPU: the session env pins JAX_PLATFORMS to the real TPU platform, but
-# tests run on the simulated multi-device mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+force_cpu_simulation(8)
 
 import jax  # noqa: E402
-
-# The image's TPU plugin overrides jax_platforms at import time (sitecustomize);
-# force the config back to CPU before any backend is initialised.
-jax.config.update("jax_platforms", "cpu")
-
 import pytest  # noqa: E402
 
 from dlbb_tpu.comm import MeshSpec, build_mesh  # noqa: E402

@@ -1,0 +1,150 @@
+"""Bench harness + stats pipeline integration tests on the simulated mesh.
+
+The reference's benchmark scripts double as integration tests (SURVEY §4);
+here a miniature sweep runs end-to-end — payload → timed collective → JSON —
+and the stats pipeline consumes the artifacts, mirroring the
+results/ → stats/ flow of the reference.
+"""
+
+import json
+
+import numpy as np
+
+from dlbb_tpu.bench import Sweep1D, Sweep3D, run_sweep
+from dlbb_tpu.stats import process_1d_results, process_3d_results
+
+
+def _tiny_1d(tmp_path, **kw):
+    defaults = dict(
+        implementation="xla_test",
+        operations=("allreduce", "broadcast", "sendrecv"),
+        data_sizes=(("1KB", 256), ("64KB", 16384)),
+        rank_counts=(2, 4, 16),  # 16 must be skipped (only 8 devices)
+        dtype="float32",
+        warmup_iterations=1,
+        measurement_iterations=3,
+        output_dir=str(tmp_path / "results"),
+    )
+    defaults.update(kw)
+    return Sweep1D(**defaults)
+
+
+def test_sweep_1d_writes_reference_schema(tmp_path, devices):
+    files = run_sweep(_tiny_1d(tmp_path), verbose=False)
+    # 3 ops x 2 sizes x 2 feasible rank counts
+    assert len(files) == 12
+    data = json.loads(files[0].read_text())
+    for key in (
+        "implementation", "operation", "num_ranks", "data_size_name",
+        "num_elements", "dtype", "warmup_iterations",
+        "measurement_iterations", "timings",
+    ):
+        assert key in data, key
+    assert data["num_ranks"] in (2, 4)
+    timings = np.asarray(data["timings"])
+    assert timings.ndim == 2 and timings.shape[1] == 3
+    assert (timings > 0).all()
+
+
+def test_sweep_1d_rank_gate(tmp_path, devices):
+    files = run_sweep(_tiny_1d(tmp_path, rank_counts=(16,)), verbose=False)
+    assert files == []  # all configs infeasible on 8 devices
+
+
+def test_sweep_1d_hierarchical_variant(tmp_path, devices):
+    sweep = _tiny_1d(
+        tmp_path,
+        variant="hier2x2x2",
+        operations=("allreduce",),
+        rank_counts=(8,),
+    )
+    files = run_sweep(sweep, verbose=False)
+    assert len(files) == 2
+    data = json.loads(files[0].read_text())
+    assert data["implementation"] == "xla_test_hier2x2x2"
+    assert data["mesh_shape"] == [2, 2, 2]
+
+
+def test_stats_1d_pipeline(tmp_path, devices):
+    run_sweep(_tiny_1d(tmp_path), verbose=False)
+    results = process_1d_results(
+        tmp_path / "results", tmp_path / "stats", verbose=False
+    )
+    assert len(results) == 12
+    r = results[0]
+    for key in (
+        "mean_time_us", "median_time_us", "p95_time_us", "p99_time_us",
+        "load_imbalance_percent", "bandwidth_gbps", "per_rank_means_us",
+    ):
+        assert key in r, key
+    assert r["bandwidth_gbps"] > 0
+    # consolidated CSV with reference columns
+    csv_text = (tmp_path / "stats" / "benchmark_statistics.csv").read_text()
+    header = csv_text.splitlines()[0]
+    assert header.startswith("mpi_implementation,operation,num_ranks")
+    assert "bandwidth_gbps" in header
+    # per-file stats JSONs exist
+    assert len(list((tmp_path / "stats").glob("*_stats.json"))) == 12
+
+
+def test_sweep_3d_and_stats(tmp_path, devices):
+    sweep = Sweep3D(
+        implementation="xla_test",
+        operations=("allreduce", "allgather"),
+        batch_sizes=(1, 2),
+        seq_lengths=(8,),
+        hidden_dims=(16,),
+        rank_counts=(4,),
+        dtype="bfloat16",
+        warmup_iterations=1,
+        measurement_iterations=2,
+        output_dir=str(tmp_path / "results3d"),
+    )
+    files = run_sweep(sweep, verbose=False)
+    assert len(files) == 4
+    data = json.loads(files[0].read_text())
+    assert data["tensor_shape"] == {"batch": 1, "seq_len": 8, "hidden_dim": 16}
+    assert data["tensor_size_mb"] == 1 * 8 * 16 * 2 / 2**20
+
+    results = process_3d_results(
+        tmp_path / "results3d", tmp_path / "stats3d", "xla_test", verbose=False
+    )
+    assert len(results) == 4
+    std = tmp_path / "stats3d" / "benchmark_statistics_3d_xla_test_standard.csv"
+    tr = tmp_path / "stats3d" / "benchmark_statistics_3d_xla_test_transpose.csv"
+    assert std.exists() and tr.exists()
+    header = std.read_text().splitlines()[0]
+    assert header == (
+        "implementation,operation,num_ranks,hidden_dim,seq_len,batch,"
+        "tensor_size_mb,num_elements,mean_time_ms,median_time_ms,"
+        "min_time_ms,max_time_ms"
+    )
+    # transpose CSV: metrics as rows, config ids as columns
+    lines = tr.read_text().splitlines()
+    assert lines[0].startswith("Metric,allgather_r4_h16_s8_b1")
+    assert lines[1].startswith("mean_time_ms,")
+
+
+def test_stats_reads_reference_artifact(tmp_path):
+    """The pipeline must ingest the reference's own result JSONs (same
+    schema, 'mpi_implementation' key)."""
+    ref = {
+        "mpi_implementation": "openmpi",
+        "operation": "allreduce",
+        "num_ranks": 4,
+        "data_size_name": "1KB",
+        "num_elements": 256,
+        "dtype": "<class 'numpy.float16'>",
+        "warmup_iterations": 10,
+        "measurement_iterations": 3,
+        "timings": [[1e-4, 1.2e-4, 0.9e-4]] * 4,
+    }
+    d = tmp_path / "ref"
+    d.mkdir()
+    (d / "openmpi_allreduce_ranks4_1KB.json").write_text(json.dumps(ref))
+    results = process_1d_results(d, tmp_path / "refstats", verbose=False)
+    assert len(results) == 1
+    assert results[0]["mpi_implementation"] == "openmpi"
+    # fp16 element size resolved from the numpy-repr dtype string
+    expected_bw = 256 * 2 * 4 / (1.2e-4) / 2**30
+    np.testing.assert_allclose(results[0]["bandwidth_gbps"], expected_bw, rtol=1e-9)

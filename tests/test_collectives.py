@@ -22,15 +22,6 @@ AXES = ("ranks",)
 N = 64
 
 
-def _rows(num, n=N, dtype=np.float32, seed=42):
-    return np.stack(
-        [
-            np.random.default_rng(seed + r).standard_normal((n,), dtype=np.float32)
-            for r in range(num)
-        ]
-    ).astype(dtype)
-
-
 def _np_input(op_name, mesh, dtype=jnp.float32):
     op = get_op(op_name)
     x = make_payload(op, mesh, AXES, N, dtype=dtype)
