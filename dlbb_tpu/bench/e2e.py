@@ -1,0 +1,190 @@
+"""End-to-end tensor-parallel forward-pass benchmark.
+
+Replacement for the reference's E2E harness (``run_mpi.py``): YAML config in,
+TP transformer + fixed synthetic batch, warmup + timed forward passes,
+metrics JSON out.  Differences by design:
+
+- ``mpirun``-spawned ranks → a ``(dp, tp)`` device mesh; the reference's
+  ``world_size`` is the TP degree (its only model parallelism — SURVEY §2.2);
+- per-iteration ``comm.Barrier()`` pairs (``run_mpi.py:177,183``) →
+  ``block_until_ready`` on the jitted step;
+- the warmup loop (``run_mpi.py:154-166``) absorbs XLA compilation, which is
+  timed separately (first-call cost is compile, not page-faulting —
+  SURVEY §7);
+- cross-rank variance/CV of forward means (``run_mpi.py:199-212``) becomes
+  cross-*host* variance; on a single process it is zero and recorded as such.
+"""
+
+from __future__ import annotations
+
+import time
+from pathlib import Path
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
+from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.models.sharding import batch_spec
+from dlbb_tpu.models.transformer import (
+    forward,
+    init_params,
+    num_parameters,
+    shard_params,
+)
+from dlbb_tpu.utils.config import load_config, save_json
+from dlbb_tpu.utils.metrics import summarize
+from dlbb_tpu.utils.sysinfo import collect_system_info
+from dlbb_tpu.utils.timing import (
+    force_completion,
+    resolve_timing_mode,
+    time_fn_chained,
+    time_fn_per_iter,
+)
+
+
+def build_e2e_mesh(world_size: int, data_parallel: int = 1,
+                   devices: Optional[Sequence] = None):
+    """Mesh for the E2E benchmark: ``(dp, tp)`` with tp = the reference's
+    ``world_size`` (``config/baseline_config.yaml:17``)."""
+    spec = MeshSpec.grid((data_parallel, world_size), ("dp", "tp"))
+    return build_mesh(spec, devices=devices)
+
+
+def run_e2e(
+    config: dict[str, Any],
+    devices: Optional[Sequence] = None,
+    output_dir: Optional[str] = None,
+    verbose: bool = True,
+) -> dict[str, Any]:
+    """Run the benchmark described by ``config`` (schema:
+    ``configs/baseline_config.yaml``; parity with ``run_mpi.py:main``)."""
+    t_init = time.perf_counter()
+
+    par = config.get("parallelism", {})
+    world_size = par.get("world_size", 1)
+    data_parallel = par.get("data_parallel", 1)
+    needed = world_size * data_parallel
+    n_avail = len(devices) if devices is not None else len(jax.devices())
+    if needed > n_avail:
+        # world-size preflight, parity with run_mpi.py:73-77
+        raise ValueError(
+            f"config needs {needed} devices (tp={world_size} x "
+            f"dp={data_parallel}), only {n_avail} available"
+        )
+
+    mesh = build_e2e_mesh(world_size, data_parallel, devices)
+    model_cfg = ModelConfig.from_dict(config["model"])
+    dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
+
+    params = init_params(model_cfg, jax.random.key(config["input"].get("seed", 42)))
+    params = shard_params(params, mesh)
+    # hidden size comes from the resolved ModelConfig, not the raw YAML —
+    # a `size: "7B"` config need not spell out hidden_size
+    dataset = SyntheticEmbeddingDataset(
+        batch_size=config["input"]["batch_size"],
+        seq_length=config["input"]["sequence_length"],
+        hidden_size=model_cfg.hidden_size,
+        seed=config["input"].get("seed", 42),
+        dtype=dtype,
+        mesh=mesh,
+        spec=batch_spec(),
+    )
+    batch = dataset.get_batch()
+    init_time = time.perf_counter() - t_init
+
+    out_sharding = NamedSharding(mesh, batch_spec())
+    step = jax.jit(
+        lambda p, x: forward(p, x, model_cfg), out_shardings=out_sharding
+    )
+
+    execution = config.get("execution", {})
+    warmup = execution.get("warmup_iterations", 5)
+    iters = execution.get("benchmark_iterations", 10)
+
+    # The model maps [B,S,H] -> [B,S,H], so chained timing on remote-async
+    # backends feeds the output straight back as the next input.
+    mode = resolve_timing_mode("auto")
+
+    t0 = time.perf_counter()
+    force_completion(step(params, batch))
+    compile_time = time.perf_counter() - t0
+
+    if mode == "per_iter":
+        forward_times = time_fn_per_iter(
+            step, params, batch, warmup=max(0, warmup - 1), iterations=iters
+        )
+        timing_meta = {
+            "timing_mode": "per_iter",
+            "timing_method": "time.perf_counter() + jax.block_until_ready()",
+        }
+    else:
+        forward_times, timing_meta = time_fn_chained(
+            step, batch, warmup=1, iterations=iters,
+            chunk_size=min(5, iters), op_args=(params,),
+        )
+
+    # cross-host spread of mean forward time (run_mpi.py:199-212 analogue)
+    local_mean = float(np.mean(forward_times))
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        host_means = np.asarray(
+            multihost_utils.process_allgather(np.float64(local_mean))
+        ).ravel()
+    else:
+        host_means = np.asarray([local_mean])
+    variance = float(host_means.var())
+    cv = float(host_means.std() / host_means.mean()) if host_means.mean() > 0 else 0.0
+
+    tokens = (config["input"]["batch_size"] * config["input"]["sequence_length"])
+    result = {
+        "experiment": config.get("experiment", {}),
+        "backend": "xla_tpu",
+        "config": config,
+        "model": {
+            "num_parameters": num_parameters(model_cfg),
+            "attention": model_cfg.attention,
+            "dtype": model_cfg.dtype,
+        },
+        "mesh": {"dp": data_parallel, "tp": world_size},
+        "init_time_s": init_time,
+        "compile_time_s": compile_time,
+        "forward_time": summarize(forward_times),
+        **timing_meta,
+        "per_host_means_s": host_means.tolist(),
+        "cross_host_variance": variance,
+        "cross_host_cv": cv,
+        "tokens_per_second": tokens / local_mean,
+        "timings": [forward_times],
+        "system_info": collect_system_info(),
+        "timestamp": time.time(),
+    }
+
+    if verbose:
+        ft = result["forward_time"]
+        print(
+            f"[e2e] {config.get('experiment', {}).get('name', 'experiment')}: "
+            f"forward mean {ft['mean'] * 1e3:.2f} ms "
+            f"(p95 {ft['p95'] * 1e3:.2f} ms), compile {compile_time:.1f} s, "
+            f"{result['tokens_per_second']:.0f} tok/s"
+        )
+
+    if output_dir is not None:
+        name = config.get("experiment", {}).get("name", "experiment")
+        save_json(result, Path(output_dir) / f"xla_tpu_{name}.json")
+    return result
+
+
+def run_e2e_from_config(
+    config_path: str,
+    output_dir: Optional[str] = None,
+    devices: Optional[Sequence] = None,
+) -> dict[str, Any]:
+    config = load_config(config_path)
+    out = output_dir or config.get("experiment", {}).get("output_dir")
+    return run_e2e(config, devices=devices, output_dir=out)

@@ -41,8 +41,8 @@ from dlbb_tpu.comm.ops import (
 )
 from dlbb_tpu.comm.variants import Variant, get_variant
 from dlbb_tpu.utils.config import save_json
-from dlbb_tpu.utils.metrics import time_fn
 from dlbb_tpu.utils.sysinfo import collect_system_info
+from dlbb_tpu.utils.timing import time_collective
 
 # Reference 1D sweep constants (``collectives/1d/openmpi.py:14-49``).
 # NOTE the reference's size labels are 2x the actual fp16 payload
@@ -103,6 +103,8 @@ class Sweep1D:
     measurement_iterations: int = 100
     output_dir: str = "results/1d"
     root: int = 0
+    # "auto" | "per_iter" | "chained" — see dlbb_tpu.utils.timing
+    timing_mode: str = "auto"
 
     kind: str = "1d"
 
@@ -123,6 +125,7 @@ class Sweep3D:
     measurement_iterations: int = 100
     output_dir: str = "results/3d"
     root: int = 0
+    timing_mode: str = "auto"
 
     kind: str = "3d"
 
@@ -271,11 +274,14 @@ def _run_one(
         op, mesh, axes, num_elements, dtype=dtype, shape=payload_shape
     )
     fn = _build_fn(op_name, variant, mesh, axes, sweep.root)
+    chain = op.make_chain(num_ranks) if op.make_chain is not None else None
 
-    local = time_fn(
+    local, timing_meta = time_collective(
         fn, x,
+        chain=chain,
         warmup=sweep.warmup_iterations,
         iterations=sweep.measurement_iterations,
+        mode=sweep.timing_mode,
     )
     timings = _gather_timings(local)
 
@@ -288,7 +294,7 @@ def _run_one(
         "dtype": sweep.dtype,
         "warmup_iterations": sweep.warmup_iterations,
         "measurement_iterations": sweep.measurement_iterations,
-        "timing_method": "time.perf_counter() + jax.block_until_ready()",
+        **timing_meta,
         "timings": timings,
         "variant": variant.name,
         **dict(variant.extra),

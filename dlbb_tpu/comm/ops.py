@@ -52,11 +52,17 @@ class CollectiveOp:
       "per_rank"  — global ``[P, n]``, device i owns row i (one buffer/rank)
       "per_peer"  — global ``[P, P, n]``, device i owns slab i (one buffer per
                     peer, as for MPI_Scatter's root sendbuf / MPI_Alltoall)
+
+    make_chain(P) returns glue mapping the op's output back to a valid next
+    input, used by chained timing (``dlbb_tpu.utils.timing``) to iterate the
+    op inside one jitted loop without letting XLA hoist it; None means the
+    output already has the input's shape and feeds back directly.
     """
 
     name: str
     input_kind: str
     build: Callable[..., Callable]  # (mesh, axes, root) -> fn(global) -> global
+    make_chain: Optional[Callable[[int], Callable]] = None
 
 
 def _rank_id(axes: Sequence[str]) -> jax.Array:
@@ -245,18 +251,53 @@ def build_barrier(mesh, axes, root=0):
 # registry
 # ---------------------------------------------------------------------------
 
+# Chain glue for chained timing: map output back to input shape with
+# negligible work relative to the collective (values are irrelevant to
+# timing; the dependency prevents loop-invariant hoisting).
+def _chain_rescale(p: int):
+    return lambda out: out * (1.0 / p)  # keep allreduce sums from blowing up
+
+
+def _chain_take_first(p: int):
+    return lambda out: out[:, 0]  # [P, P, *shape] -> [P, *shape]
+
+
+def _chain_rebroadcast(p: int):
+    def chain(out):  # [P, *shape] -> [P, P, *shape]
+        return jnp.broadcast_to(out[:, None], (out.shape[0], p) + out.shape[1:])
+
+    return chain
+
+
+def _chain_scatter_back(p: int):
+    def chain(out):  # reducescatter [P, 1, n] -> [P, P, n], rescaled
+        tiled = jnp.broadcast_to(out, (out.shape[0], p) + out.shape[2:])
+        return tiled * (1.0 / p)
+
+    return chain
+
+
 OPERATIONS: dict[str, CollectiveOp] = {
-    "allreduce": CollectiveOp("allreduce", "per_rank", build_allreduce),
-    "allgather": CollectiveOp("allgather", "per_rank", build_allgather),
+    "allreduce": CollectiveOp(
+        "allreduce", "per_rank", build_allreduce, _chain_rescale
+    ),
+    "allgather": CollectiveOp(
+        "allgather", "per_rank", build_allgather, _chain_take_first
+    ),
     "broadcast": CollectiveOp("broadcast", "per_rank", build_broadcast),
-    "gather": CollectiveOp("gather", "per_rank", build_gather),
-    "scatter": CollectiveOp("scatter", "per_peer", build_scatter),
-    "reduce": CollectiveOp("reduce", "per_rank", build_reduce),
+    "gather": CollectiveOp("gather", "per_rank", build_gather, _chain_take_first),
+    "scatter": CollectiveOp(
+        "scatter", "per_peer", build_scatter, _chain_rebroadcast
+    ),
+    "reduce": CollectiveOp("reduce", "per_rank", build_reduce, _chain_rescale),
     "alltoall": CollectiveOp("alltoall", "per_peer", build_alltoall),
     "sendrecv": CollectiveOp("sendrecv", "per_rank", build_sendrecv),
-    "reducescatter": CollectiveOp("reducescatter", "per_peer", build_reducescatter),
+    "reducescatter": CollectiveOp(
+        "reducescatter", "per_peer", build_reducescatter, _chain_scatter_back
+    ),
     "allreduce_hierarchical": CollectiveOp(
-        "allreduce_hierarchical", "per_rank", build_allreduce_hierarchical
+        "allreduce_hierarchical", "per_rank", build_allreduce_hierarchical,
+        _chain_rescale,
     ),
 }
 

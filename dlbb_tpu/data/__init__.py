@@ -1,0 +1,8 @@
+"""Synthetic data (L3 replacement, reference ``data_gen.py``)."""
+
+from dlbb_tpu.data.synthetic import (
+    SyntheticEmbeddingDataset,
+    create_dataset_from_config,
+)
+
+__all__ = ["SyntheticEmbeddingDataset", "create_dataset_from_config"]

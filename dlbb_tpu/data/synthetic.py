@@ -1,0 +1,68 @@
+"""Synthetic embedding batches.
+
+Parity with reference ``data_gen.py``: one fixed, seeded batch of shape
+``[batch, seq_len, hidden]`` (seed 42, ``data_gen.py:37``) returned on every
+``get_batch()`` call — the benchmark measures compute/communication, not
+input variety.  Optionally placed on the mesh with a batch sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+class SyntheticEmbeddingDataset:
+    """Fixed seeded batch (reference ``SyntheticEmbeddingDataset``
+    ``data_gen.py:10-53``)."""
+
+    def __init__(
+        self,
+        batch_size: int,
+        seq_length: int,
+        hidden_size: int,
+        seed: int = 42,
+        dtype=jnp.bfloat16,
+        mesh: Optional[Mesh] = None,
+        spec: Optional[PartitionSpec] = None,
+    ) -> None:
+        self.batch_size = batch_size
+        self.seq_length = seq_length
+        self.hidden_size = hidden_size
+        self.seed = seed
+        rng = np.random.default_rng(seed)
+        host = rng.standard_normal(
+            (batch_size, seq_length, hidden_size), dtype=np.float32
+        )
+        batch = jnp.asarray(host, dtype=dtype)
+        if mesh is not None:
+            batch = jax.device_put(
+                batch, NamedSharding(mesh, spec or PartitionSpec())
+            )
+        self._batch = batch
+
+    def get_batch(self) -> jax.Array:
+        return self._batch
+
+
+def create_dataset_from_config(
+    config: dict[str, Any],
+    mesh: Optional[Mesh] = None,
+    spec: Optional[PartitionSpec] = None,
+    dtype=jnp.bfloat16,
+) -> SyntheticEmbeddingDataset:
+    """Build from the YAML ``input:`` + ``model:`` sections (reference
+    ``create_dataset_from_config`` ``data_gen.py:56-73``)."""
+    return SyntheticEmbeddingDataset(
+        batch_size=config["input"]["batch_size"],
+        seq_length=config["input"]["sequence_length"],
+        hidden_size=config["model"]["hidden_size"],
+        seed=config["input"].get("seed", 42),
+        dtype=dtype,
+        mesh=mesh,
+        spec=spec,
+    )

@@ -1,0 +1,26 @@
+"""Tensor-parallel transformer models (L2 replacement).
+
+Megatron-style column/row-parallel decoder with the reference's semantics
+(``models.py``), expressed TPU-first: parallelism is GSPMD partition specs on
+a ``(dp, tp)`` mesh — the two all-reduces per layer that the reference
+hand-writes (``models.py:95``) are inserted by XLA from the sharding layout.
+"""
+
+from dlbb_tpu.models.configs import MODEL_CONFIGS, ModelConfig
+from dlbb_tpu.models.transformer import (
+    forward,
+    init_params,
+    num_parameters,
+    shard_params,
+)
+from dlbb_tpu.models.sharding import param_specs
+
+__all__ = [
+    "MODEL_CONFIGS",
+    "ModelConfig",
+    "init_params",
+    "forward",
+    "num_parameters",
+    "shard_params",
+    "param_specs",
+]

@@ -1,0 +1,69 @@
+"""Model size configurations (reference ``models.py:252-271`` MODEL_CONFIGS).
+
+``attention="simplified"`` replicates the reference's benchmarking shortcut
+(take the query third of the QKV projection as the attention output,
+``models.py:162-167``); ``attention="full"`` is real causal multi-head
+attention — an option the reference lacks but a real framework needs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Any
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    ffn_intermediate: int
+    attention: str = "full"  # "full" | "simplified"
+    dtype: str = "bfloat16"
+
+    def __post_init__(self) -> None:
+        if self.hidden_size % self.num_heads != 0:
+            raise ValueError(
+                f"hidden_size {self.hidden_size} not divisible by "
+                f"num_heads {self.num_heads}"
+            )
+        if self.attention not in ("full", "simplified"):
+            raise ValueError(f"unknown attention mode {self.attention!r}")
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def with_(self, **kw) -> "ModelConfig":
+        return replace(self, **kw)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ModelConfig":
+        """Build from the YAML ``model:`` section
+        (``configs/baseline_config.yaml``, schema parity with reference
+        ``config/baseline_config.yaml:7-13``).  A ``size:`` key selects a
+        named config; explicit fields override it."""
+        d = dict(d)
+        size = d.pop("size", None)
+        base = MODEL_CONFIGS[size] if size else None
+        fields = {}
+        for k in (
+            "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
+            "attention", "dtype",
+        ):
+            if k in d:
+                fields[k] = d[k]
+            elif base is not None:
+                fields[k] = getattr(base, k)
+        return cls(**fields)
+
+
+# Reference sizes (``models.py:252-271``).
+MODEL_CONFIGS: dict[str, ModelConfig] = {
+    "1B": ModelConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                      ffn_intermediate=8192),
+    "7B": ModelConfig(hidden_size=4096, num_layers=32, num_heads=32,
+                      ffn_intermediate=16384),
+    "13B": ModelConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                       ffn_intermediate=20480),
+}

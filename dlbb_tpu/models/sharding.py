@@ -1,0 +1,44 @@
+"""GSPMD partition specs for the TP transformer.
+
+The reference implements tensor parallelism imperatively: per-rank weight
+shards plus a hand-written ``comm.Allreduce`` after each row-parallel matmul
+(``models.py:19-47`` column, ``:50-100`` row, allreduce ``:95``).  On TPU the
+same Megatron layout is *declared*: shard the QKV / FFN-up kernels on their
+output dim and the out-proj / FFN-down kernels on their input dim over the
+``tp`` mesh axis, and XLA GSPMD inserts exactly the two per-layer
+all-reduces over ICI.
+
+Layer params are stacked on a leading ``num_layers`` axis (scanned in the
+forward pass), so every spec below leads with ``None`` for that axis.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+TP_AXIS = "tp"
+DP_AXIS = "dp"
+
+
+def param_specs(tp_axis: str = TP_AXIS) -> dict:
+    """PartitionSpec pytree matching ``init_params``' structure."""
+    t = tp_axis
+    return {
+        "layers": {
+            "ln1": {"scale": P(None), "bias": P(None)},
+            # column parallel: shard out_features (reference models.py:19-47)
+            "qkv": {"kernel": P(None, None, t), "bias": P(None, t)},
+            # row parallel: shard in_features; partial sums -> psum
+            # (reference models.py:50-100)
+            "out": {"kernel": P(None, t, None), "bias": P(None, None)},
+            "ln2": {"scale": P(None), "bias": P(None)},
+            "ffn_up": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "ffn_down": {"kernel": P(None, t, None), "bias": P(None, None)},
+        },
+        "ln_f": {"scale": P(None), "bias": P(None)},
+    }
+
+
+def batch_spec(dp_axis: str = DP_AXIS) -> P:
+    """Activations sharded over data parallelism on the batch dim."""
+    return P(dp_axis, None, None)

@@ -1,0 +1,7 @@
+"""Training loop: DDP gradient all-reduce + ZeRO-1 optimizer-state sharding
+(parity with the reference's only backward path, ``test/ccl.py:59-117``
+DeepSpeed ZeRO; BASELINE.json configs 4-5)."""
+
+from dlbb_tpu.train.loop import TrainState, make_train_step, run_train
+
+__all__ = ["TrainState", "make_train_step", "run_train"]

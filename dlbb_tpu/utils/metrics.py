@@ -75,24 +75,3 @@ class Timer:
 
             jax.block_until_ready(self._sync)
         self.elapsed = time.perf_counter() - self._start
-
-
-def time_fn(fn, *args, warmup: int, iterations: int) -> list[float]:
-    """Benchmark a jitted function: ``warmup`` calls absorb compilation (the
-    analogue of the reference's warmup loops, which absorbed page-faults —
-    ``collectives/1d/openmpi.py:253-259``), then ``iterations`` timed calls,
-    each bracketed by ``block_until_ready`` (the barrier analogue of
-    ``comm.Barrier(); MPI.Wtime()`` at ``collectives/1d/openmpi.py:60-66``).
-
-    Returns per-iteration wall times in seconds.
-    """
-    import jax
-
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    timings = []
-    for _ in range(iterations):
-        start = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        timings.append(time.perf_counter() - start)
-    return timings

@@ -1,0 +1,171 @@
+"""Device-honest benchmark timing.
+
+Two regimes (SURVEY §7 "timing semantics under async dispatch"):
+
+- **per_iter** — platforms where ``jax.block_until_ready`` genuinely waits for
+  device completion (CPU, locally-attached TPU): time each call bracketed by
+  ``block_until_ready``, the analogue of the reference's
+  ``Barrier(); Wtime(); op; Wtime()`` (``collectives/1d/openmpi.py:60-66``).
+
+- **chained** — remotely-attached backends (this image's tunneled TPU,
+  backend name ``axon``) where ``block_until_ready`` returns on *enqueue*,
+  not completion, and each dispatch pays a multi-ms tunnel roundtrip.
+  Honest numbers require (a) forcing a data dependency (fetch a scalar
+  derived from the result) and (b) amortising the roundtrip: run M iterations
+  of ``chain(op(x))`` inside ONE jitted ``lax.fori_loop`` (single dispatch),
+  fetch, subtract the calibrated fetch baseline, divide by M.  The chain
+  glue feeds each iteration's output back as the next input so XLA cannot
+  hoist the op out of the loop.
+
+``resolve_timing_mode("auto")`` picks per_iter unless the backend is known
+remote-async (or ``DLBB_TIMING_MODE`` overrides).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+def _remote_async_backend() -> bool:
+    """True when the device runtime is remotely attached and
+    ``block_until_ready`` returns on enqueue rather than completion.
+
+    The tunneled-TPU plugin registers its platform under the name "tpu", so
+    backend name alone cannot distinguish it from a locally-attached TPU; the
+    plugin's environment markers can.
+    """
+    if jax.default_backend() == "cpu":
+        return False  # simulated mesh: block_until_ready is a real sync
+    if "axon" in os.environ.get("JAX_PLATFORMS", ""):
+        return True
+    if os.environ.get("PALLAS_AXON_TPU_GEN"):
+        return True
+    return False
+
+
+def resolve_timing_mode(mode: str = "auto") -> str:
+    if mode != "auto":
+        return mode
+    env = os.environ.get("DLBB_TIMING_MODE")
+    if env:
+        return env
+    return "chained" if _remote_async_backend() else "per_iter"
+
+
+def force_completion(x: Any) -> float:
+    """Force completion of ``x`` via a minimal data-dependent fetch (a scalar
+    derived from the result must cross the wire, so enqueue cannot satisfy
+    it)."""
+    leaf = jax.tree.leaves(x)[0]
+    return float(jnp.asarray(leaf).ravel()[0])
+
+
+_force = force_completion
+
+
+def calibrate_fetch_overhead(x: Any, trials: int = 5) -> float:
+    """Roundtrip cost of the forcing fetch on an already-ready value (min of
+    ``trials``)."""
+    _force(x)  # ensure ready
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        _force(x)
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def time_fn_per_iter(fn, *args, warmup: int, iterations: int) -> list[float]:
+    """Per-iteration block_until_ready timing (sync backends)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    out = []
+    for _ in range(iterations):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+def time_fn_chained(
+    op: Callable,
+    x: Any,
+    chain: Optional[Callable] = None,
+    warmup: int = 1,
+    iterations: int = 100,
+    chunk_size: Optional[int] = None,
+    op_args: tuple = (),
+) -> tuple[list[float], dict[str, Any]]:
+    """Chunked fori_loop timing (remote-async backends).
+
+    ``op`` is invoked as ``op(*op_args, carry)``.  Anything large the op
+    needs (model params!) MUST go through ``op_args``, not a closure: arrays
+    closed over by the jitted loop are embedded as compile-time constants,
+    which at model scale stalls compilation indefinitely.
+
+    Returns ``(samples, meta)``: each sample is the estimated per-iteration
+    time of one chunk, ``(chunk_wall - fetch_overhead) / chunk_size``;
+    ``len(samples) == iterations // chunk_size`` (≥ 1).
+    """
+    if chunk_size is None:
+        chunk_size = max(1, min(10, iterations // 10 or 1))
+    chunks = max(1, iterations // chunk_size)
+
+    def body(args, c):
+        out = op(*args, c)
+        return chain(out) if chain is not None else out
+
+    looped = jax.jit(
+        lambda args, x0: jax.lax.fori_loop(
+            0, chunk_size, lambda i, c: body(args, c), x0
+        )
+    )
+
+    for _ in range(max(1, warmup)):
+        _force(looped(op_args, x))
+    overhead = calibrate_fetch_overhead(x)
+
+    samples = []
+    for _ in range(chunks):
+        t0 = time.perf_counter()
+        _force(looped(op_args, x))
+        wall = time.perf_counter() - t0
+        samples.append(max(wall - overhead, 0.0) / chunk_size)
+    meta = {
+        "timing_mode": "chained",
+        "timing_method": (
+            "jitted lax.fori_loop chunks + data-dependent fetch, "
+            "fetch overhead subtracted (remote-async backend)"
+        ),
+        "timing_granularity": f"chunked({chunk_size})",
+        "chunks": chunks,
+        "chunk_size": chunk_size,
+        "fetch_overhead_s": overhead,
+    }
+    return samples, meta
+
+
+def time_collective(
+    op: Callable,
+    x: Any,
+    chain: Optional[Callable] = None,
+    warmup: int = 10,
+    iterations: int = 100,
+    mode: str = "auto",
+) -> tuple[list[float], dict[str, Any]]:
+    """Unified entry: returns (per-iteration timings, metadata)."""
+    mode = resolve_timing_mode(mode)
+    if mode == "per_iter":
+        timings = time_fn_per_iter(op, x, warmup=warmup, iterations=iterations)
+        return timings, {
+            "timing_mode": "per_iter",
+            "timing_method": "time.perf_counter() + jax.block_until_ready()",
+            "timing_granularity": "per_iteration",
+        }
+    return time_fn_chained(
+        op, x, chain=chain, warmup=max(1, warmup // 10), iterations=iterations
+    )

@@ -1,0 +1,57 @@
+"""E2E harness tests (reference ``run_mpi.py`` flow: config → model → data →
+warmup → timed benchmark → metrics JSON)."""
+
+import json
+
+import pytest
+
+from dlbb_tpu.bench.e2e import run_e2e
+from dlbb_tpu.data import SyntheticEmbeddingDataset
+
+
+def _config(**over):
+    cfg = {
+        "experiment": {"name": "smoke", "output_dir": None},
+        "model": {
+            "hidden_size": 64,
+            "num_layers": 2,
+            "num_heads": 4,
+            "ffn_intermediate": 128,
+            "attention": "simplified",
+        },
+        "parallelism": {"world_size": 4, "data_parallel": 2},
+        "input": {"batch_size": 4, "sequence_length": 16, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 3},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_e2e_runs_and_writes_metrics(tmp_path, devices):
+    result = run_e2e(_config(), output_dir=str(tmp_path), verbose=False)
+    assert result["mesh"] == {"dp": 2, "tp": 4}
+    assert result["forward_time"]["count"] == 3
+    assert result["forward_time"]["mean"] > 0
+    assert result["compile_time_s"] > 0
+    assert result["tokens_per_second"] > 0
+    assert result["cross_host_variance"] == 0.0  # single process
+    saved = json.loads((tmp_path / "xla_tpu_smoke.json").read_text())
+    assert saved["model"]["num_parameters"] == result["model"]["num_parameters"]
+
+
+def test_e2e_world_size_preflight(devices):
+    """Device-count validation, parity with run_mpi.py:73-77."""
+    cfg = _config(parallelism={"world_size": 16, "data_parallel": 1})
+    with pytest.raises(ValueError, match="16 devices"):
+        run_e2e(cfg, verbose=False)
+
+
+def test_dataset_is_fixed_and_seeded(devices):
+    a = SyntheticEmbeddingDataset(2, 8, 16, seed=42)
+    b = SyntheticEmbeddingDataset(2, 8, 16, seed=42)
+    c = SyntheticEmbeddingDataset(2, 8, 16, seed=7)
+    import numpy as np
+
+    assert a.get_batch() is a.get_batch()  # same object every call
+    np.testing.assert_array_equal(np.asarray(a.get_batch()), np.asarray(b.get_batch()))
+    assert not np.array_equal(np.asarray(a.get_batch()), np.asarray(c.get_batch()))

@@ -1,0 +1,92 @@
+"""Training-loop tests: DDP + ZeRO-1 on the simulated (dp, tp) mesh
+(reference's training capability: ``test/ccl.py:59-117`` ZeRO train step)."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding
+
+from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
+from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.models.sharding import batch_spec
+from dlbb_tpu.models.transformer import init_params
+from dlbb_tpu.train.loop import make_train_step, opt_state_specs, run_train
+
+TINY = ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
+                   ffn_intermediate=64, attention="full", dtype="float32")
+
+
+def _config(zero=False):
+    return {
+        "experiment": {"name": "train_smoke"},
+        "model": {
+            "hidden_size": 32, "num_layers": 2, "num_heads": 4,
+            "ffn_intermediate": 64, "attention": "full", "dtype": "float32",
+        },
+        "parallelism": {"world_size": 2, "data_parallel": 4},
+        "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 6},
+        "training": {"learning_rate": 1e-2},
+    }
+
+
+@pytest.mark.parametrize("zero1", [False, True])
+def test_loss_decreases(devices, zero1):
+    """The full train step optimises: MSE loss must drop over steps
+    (reference asserts the ZeRO step merely completes; we assert progress)."""
+    result = run_train(_config(), zero1=zero1, verbose=False)
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.9, losses
+    assert result["final_step"] == 7  # warmup 1 + 6 measured
+
+
+def test_zero1_shards_optimizer_state(devices):
+    """ZeRO-1: Adam mu/nu must actually be sharded over dp, DDP must not."""
+    mesh = build_mesh(MeshSpec.grid((4, 2), ("dp", "tp")))
+    params = init_params(TINY, jax.random.key(0))
+    opt = optax.adam(1e-3)
+
+    _, state_ddp = make_train_step(TINY, mesh, opt, params, zero1=False)
+    _, state_z1 = make_train_step(TINY, mesh, opt, params, zero1=True)
+
+    def dp_sharded_leaves(opt_state):
+        count = 0
+        for leaf in jax.tree.leaves(opt_state):
+            sharding = leaf.sharding
+            if isinstance(sharding, NamedSharding) and any(
+                "dp" in (ax if isinstance(ax, tuple) else (ax,))
+                for ax in sharding.spec if ax is not None
+            ):
+                count += 1
+        return count
+
+    assert dp_sharded_leaves(state_ddp.opt_state) == 0
+    assert dp_sharded_leaves(state_z1.opt_state) > 0
+
+
+def test_zero1_matches_ddp_numerics(devices):
+    """Sharding the optimizer state must not change the optimisation
+    trajectory — same losses either way."""
+    r_ddp = run_train(_config(), zero1=False, verbose=False)
+    r_z1 = run_train(_config(), zero1=True, verbose=False)
+    np.testing.assert_allclose(
+        r_ddp["losses"], r_z1["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_opt_state_specs_scalar_replicated(devices):
+    params = init_params(TINY, jax.random.key(0))
+    opt_state = optax.adam(1e-3).init(params)
+    specs = opt_state_specs(params, opt_state, zero1=True, dp_size=4)
+    # the adam count scalar must stay replicated
+    leaves = jax.tree.leaves(specs, is_leaf=lambda x: x is not None)
+    from jax.sharding import PartitionSpec as P
+
+    counts = [s for s, l in zip(
+        jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+        jax.tree.leaves(opt_state),
+    ) if getattr(l, "ndim", None) == 0]
+    assert all(s == P() for s in counts)
