@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Headline benchmark — prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Two regimes, chosen by available device count:
+
+- **>= 2 accelerator devices**: the reference's headline — 1D allreduce bus
+  bandwidth at the "16MB" label (4,194,304 fp16/bf16 elements = 8 MiB), ring
+  mesh over all devices.  ``vs_baseline`` is against the best reference
+  backend (DeepSpeed+oneCCL, 23.29 GB/s @ 16 ranks —
+  ``collectives/1d/stats/dsccl/benchmark_statistics.csv:18``, BASELINE.md).
+
+- **1 device** (this image: one v5e chip; collectives are degenerate): the
+  E2E TP-forward benchmark (reference ``run_mpi.py`` semantics) on the 1B
+  model, tokens/s.  The reference publishes no E2E number (BASELINE.md), so
+  the baseline is (re)established by running the reference's stack — torch
+  CPU bf16, identical forward semantics, world 1 — on this host, cached in
+  ``bench_baseline_cpu.json``.
+
+All diagnostics go to stderr; stdout carries exactly the one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+CPU_BASELINE_CACHE = REPO / "bench_baseline_cpu.json"
+
+# DeepSpeed+oneCCL allreduce "16MB" @ 16 ranks (BASELINE.md)
+ONECCL_BASELINE_GBPS = 23.29
+
+E2E_BATCH, E2E_SEQ = 8, 512
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_allreduce_multichip(n: int) -> dict:
+    import jax.numpy as jnp
+
+    from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+    from dlbb_tpu.comm.ops import get_op, make_payload
+    from dlbb_tpu.stats.stats1d import calculate_bandwidth
+    from dlbb_tpu.utils.timing import time_collective
+
+    num_elements = 4_194_304  # the reference's "16MB" label
+    mesh = build_mesh(MeshSpec.ring(n))
+    op = get_op("allreduce")
+    x = make_payload(op, mesh, ("ranks",), num_elements, dtype=jnp.bfloat16)
+    fn = op.build(mesh, ("ranks",))
+    timings, meta = time_collective(
+        fn, x, chain=op.make_chain(n), warmup=10, iterations=100
+    )
+    max_t = max(timings)
+    bw = calculate_bandwidth(num_elements, "bfloat16", max_t, "allreduce", n)
+    log(f"allreduce 16MB x{n} ranks: max {max_t * 1e3:.3f} ms, {bw:.2f} GB/s "
+        f"({meta['timing_mode']})")
+    return {
+        "metric": f"1d_allreduce_16MB_bus_bandwidth_{n}ranks",
+        "value": round(bw, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(bw / ONECCL_BASELINE_GBPS, 3),
+    }
+
+
+def _cpu_baseline() -> dict:
+    if CPU_BASELINE_CACHE.exists():
+        cached = json.loads(CPU_BASELINE_CACHE.read_text())
+        log(f"cpu baseline (cached): {cached['tokens_per_second']:.0f} tok/s")
+        return cached
+    log("measuring torch-CPU reference baseline (1B, bf16) ...")
+    from dlbb_tpu.bench.torch_baseline import measure_torch_cpu_forward
+    from dlbb_tpu.models.configs import MODEL_CONFIGS
+
+    cfg = MODEL_CONFIGS["1B"]
+    result = measure_torch_cpu_forward(
+        cfg.hidden_size, cfg.num_layers, cfg.ffn_intermediate,
+        E2E_BATCH, E2E_SEQ,
+    )
+    CPU_BASELINE_CACHE.write_text(json.dumps(result, indent=2))
+    log(f"cpu baseline (measured): {result['tokens_per_second']:.0f} tok/s")
+    return result
+
+
+def bench_e2e_single_chip() -> dict:
+    from dlbb_tpu.bench.e2e import run_e2e
+
+    config = {
+        "experiment": {"name": "bench_1b_world1"},
+        "model": {"size": "1B", "attention": "simplified"},
+        "parallelism": {"world_size": 1, "data_parallel": 1},
+        "input": {"batch_size": E2E_BATCH, "sequence_length": E2E_SEQ,
+                  "seed": 42},
+        "execution": {"warmup_iterations": 3, "benchmark_iterations": 10},
+    }
+    result = run_e2e(config, verbose=False)
+    tps = result["tokens_per_second"]
+    log(f"TPU 1B forward: {result['forward_time']['mean'] * 1e3:.2f} ms, "
+        f"{tps:.0f} tok/s ({result.get('timing_mode')})")
+    baseline = _cpu_baseline()
+    return {
+        "metric": "e2e_1B_forward_throughput_vs_reference_cpu_stack",
+        "value": round(tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(tps / baseline["tokens_per_second"], 3),
+    }
+
+
+def main() -> int:
+    import jax
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+    if len(devices) >= 2:
+        out = bench_allreduce_multichip(len(devices))
+    else:
+        out = bench_e2e_single_chip()
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,9 +32,8 @@ from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.models.sharding import batch_spec
 from dlbb_tpu.models.transformer import (
     forward,
-    init_params,
+    init_params_sharded,
     num_parameters,
-    shard_params,
 )
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import summarize
@@ -81,8 +80,9 @@ def run_e2e(
     model_cfg = ModelConfig.from_dict(config["model"])
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
-    params = init_params(model_cfg, jax.random.key(config["input"].get("seed", 42)))
-    params = shard_params(params, mesh)
+    params = init_params_sharded(
+        model_cfg, jax.random.key(config["input"].get("seed", 42)), mesh
+    )
     # hidden size comes from the resolved ModelConfig, not the raw YAML —
     # a `size: "7B"` config need not spell out hidden_size
     dataset = SyntheticEmbeddingDataset(

@@ -1,0 +1,99 @@
+"""Reference-stack CPU baseline for the E2E forward benchmark.
+
+The reference framework is torch-on-CPU (bf16 Megatron-style decoder,
+``models.py``) and publishes **no** E2E result JSON (BASELINE.md: "the E2E
+baseline must be (re)established").  This module re-establishes it on the
+current host: a torch implementation with the reference's exact forward
+semantics (LN → QKV → query-third "attention" → out-proj → residual;
+LN → FFN up → gelu → down → residual; final LN), world_size=1 so the
+hand-written Allreduce disappears, measured single-process.
+
+Written from scratch against the documented semantics — no reference code is
+imported or copied.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+
+def measure_torch_cpu_forward(
+    hidden_size: int,
+    num_layers: int,
+    ffn_intermediate: int,
+    batch_size: int,
+    seq_length: int,
+    warmup: int = 1,
+    iterations: int = 2,
+    threads: int | None = None,
+) -> dict[str, Any]:
+    import torch
+
+    if threads:
+        torch.set_num_threads(threads)
+
+    dtype = torch.bfloat16
+    h, f = hidden_size, ffn_intermediate
+    torch.manual_seed(42)
+
+    class Block(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.ln1 = torch.nn.LayerNorm(h, dtype=dtype)
+            self.qkv = torch.nn.Linear(h, 3 * h, dtype=dtype)
+            self.out = torch.nn.Linear(h, h, dtype=dtype)
+            self.ln2 = torch.nn.LayerNorm(h, dtype=dtype)
+            self.up = torch.nn.Linear(h, f, dtype=dtype)
+            self.down = torch.nn.Linear(f, h, dtype=dtype)
+
+        def forward(self, x):
+            r = x
+            y = self.ln1(x)
+            qkv = self.qkv(y)
+            attn = qkv[:, :, :h]  # reference's simplified attention
+            x = self.out(attn) + r
+            r = x
+            y = self.ln2(x)
+            x = self.down(torch.nn.functional.gelu(self.up(y))) + r
+            return x
+
+    class Model(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.blocks = torch.nn.ModuleList(Block() for _ in range(num_layers))
+            self.ln_f = torch.nn.LayerNorm(h, dtype=dtype)
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return self.ln_f(x)
+
+    model = Model().eval()
+    x = torch.randn(batch_size, seq_length, h, dtype=dtype)
+
+    with torch.no_grad():
+        for _ in range(warmup):
+            model(x)
+        times = []
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            model(x)
+            times.append(time.perf_counter() - t0)
+
+    mean = sum(times) / len(times)
+    return {
+        "forward_mean_s": mean,
+        "tokens_per_second": batch_size * seq_length / mean,
+        "iterations": iterations,
+        "torch_version": torch.__version__,
+        "threads": torch.get_num_threads(),
+        "config": {
+            "hidden_size": h,
+            "num_layers": num_layers,
+            "ffn_intermediate": f,
+            "batch_size": batch_size,
+            "seq_length": seq_length,
+            "dtype": "bfloat16",
+        },
+    }

@@ -10,6 +10,7 @@ from dlbb_tpu.models.configs import MODEL_CONFIGS, ModelConfig
 from dlbb_tpu.models.transformer import (
     forward,
     init_params,
+    init_params_sharded,
     num_parameters,
     shard_params,
 )
@@ -19,6 +20,7 @@ __all__ = [
     "MODEL_CONFIGS",
     "ModelConfig",
     "init_params",
+    "init_params_sharded",
     "forward",
     "num_parameters",
     "shard_params",

@@ -34,7 +34,7 @@ from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
 from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.models.sharding import batch_spec, param_specs
-from dlbb_tpu.models.transformer import forward, init_params
+from dlbb_tpu.models.transformer import forward, init_params_sharded
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import summarize
 from dlbb_tpu.utils.sysinfo import collect_system_info
@@ -189,7 +189,9 @@ def run_train(
     lr = train_cfg.get("learning_rate", 1e-3)
     optimizer = optax.adam(lr)
 
-    params = init_params(model_cfg, jax.random.key(inp.get("seed", 42)))
+    params = init_params_sharded(
+        model_cfg, jax.random.key(inp.get("seed", 42)), mesh
+    )
     jit_step, state = make_train_step(model_cfg, mesh, optimizer, params, zero1)
 
     execution = config.get("execution", {})
