@@ -47,10 +47,18 @@ from dlbb_tpu.utils.timing import (
 
 
 def build_e2e_mesh(world_size: int, data_parallel: int = 1,
+                   sequence_parallel: int = 1,
                    devices: Optional[Sequence] = None):
-    """Mesh for the E2E benchmark: ``(dp, tp)`` with tp = the reference's
-    ``world_size`` (``config/baseline_config.yaml:17``)."""
-    spec = MeshSpec.grid((data_parallel, world_size), ("dp", "tp"))
+    """Mesh for the E2E benchmark: ``(dp, sp, tp)`` with tp = the reference's
+    ``world_size`` (``config/baseline_config.yaml:17``); the sp axis (absent
+    from the reference, SURVEY §5.7) carries ring/Ulysses context
+    parallelism."""
+    if sequence_parallel > 1:
+        spec = MeshSpec.grid(
+            (data_parallel, sequence_parallel, world_size), ("dp", "sp", "tp")
+        )
+    else:
+        spec = MeshSpec.grid((data_parallel, world_size), ("dp", "tp"))
     return build_mesh(spec, devices=devices)
 
 
@@ -67,17 +75,23 @@ def run_e2e(
     par = config.get("parallelism", {})
     world_size = par.get("world_size", 1)
     data_parallel = par.get("data_parallel", 1)
-    needed = world_size * data_parallel
+    seq_parallel = par.get("sequence_parallel", 1)
+    needed = world_size * data_parallel * seq_parallel
     n_avail = len(devices) if devices is not None else len(jax.devices())
     if needed > n_avail:
         # world-size preflight, parity with run_mpi.py:73-77
         raise ValueError(
             f"config needs {needed} devices (tp={world_size} x "
-            f"dp={data_parallel}), only {n_avail} available"
+            f"dp={data_parallel} x sp={seq_parallel}), only {n_avail} available"
         )
 
-    mesh = build_e2e_mesh(world_size, data_parallel, devices)
+    mesh = build_e2e_mesh(world_size, data_parallel, seq_parallel, devices)
     model_cfg = ModelConfig.from_dict(config["model"])
+    if model_cfg.attention in ("ring", "ulysses") and "sp" not in mesh.axis_names:
+        raise ValueError(
+            f"attention={model_cfg.attention!r} requires "
+            "parallelism.sequence_parallel > 1"
+        )
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
     params = init_params_sharded(
@@ -92,14 +106,15 @@ def run_e2e(
         seed=config["input"].get("seed", 42),
         dtype=dtype,
         mesh=mesh,
-        spec=batch_spec(),
+        spec=batch_spec(mesh),
     )
     batch = dataset.get_batch()
     init_time = time.perf_counter() - t_init
 
-    out_sharding = NamedSharding(mesh, batch_spec())
+    out_sharding = NamedSharding(mesh, batch_spec(mesh))
     step = jax.jit(
-        lambda p, x: forward(p, x, model_cfg), out_shardings=out_sharding
+        lambda p, x: forward(p, x, model_cfg, mesh=mesh),
+        out_shardings=out_sharding,
     )
 
     execution = config.get("execution", {})
@@ -151,7 +166,7 @@ def run_e2e(
             "attention": model_cfg.attention,
             "dtype": model_cfg.dtype,
         },
-        "mesh": {"dp": data_parallel, "tp": world_size},
+        "mesh": {"dp": data_parallel, "sp": seq_parallel, "tp": world_size},
         "init_time_s": init_time,
         "compile_time_s": compile_time,
         "forward_time": summarize(forward_times),

@@ -1,0 +1,30 @@
+"""Dense causal attention — the single shared kernel.
+
+Used by the model's "full" mode and as the per-head-group kernel inside
+Ulysses sequence parallelism.  fp32 softmax and PV accumulation, cast back
+to the input dtype at the end.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_causal(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """q, k, v: ``[B, num_heads, S, head_dim]`` -> same shape."""
+    d = q.shape[-1]
+    logits = (
+        jnp.einsum(
+            "bnqd,bnkd->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        )
+        / math.sqrt(d)
+    )
+    s = q.shape[2]
+    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+    logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnqk,bnkd->bnqd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

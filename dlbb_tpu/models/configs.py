@@ -18,7 +18,9 @@ class ModelConfig:
     num_layers: int
     num_heads: int
     ffn_intermediate: int
-    attention: str = "full"  # "full" | "simplified"
+    # "full" | "simplified" (reference parity) | "ring" | "ulysses"
+    # (sequence/context-parallel attention — dlbb_tpu.parallel)
+    attention: str = "full"
     dtype: str = "bfloat16"
 
     def __post_init__(self) -> None:
@@ -27,7 +29,7 @@ class ModelConfig:
                 f"hidden_size {self.hidden_size} not divisible by "
                 f"num_heads {self.num_heads}"
             )
-        if self.attention not in ("full", "simplified"):
+        if self.attention not in ("full", "simplified", "ring", "ulysses"):
             raise ValueError(f"unknown attention mode {self.attention!r}")
 
     @property

@@ -39,6 +39,10 @@ def param_specs(tp_axis: str = TP_AXIS) -> dict:
     }
 
 
-def batch_spec(dp_axis: str = DP_AXIS) -> P:
-    """Activations sharded over data parallelism on the batch dim."""
+def batch_spec(mesh=None, dp_axis: str = DP_AXIS, sp_axis: str = "sp") -> P:
+    """Activations sharded over data parallelism on the batch dim, and —
+    when the mesh has a sequence-parallel axis — over ``sp`` on the
+    sequence dim."""
+    if mesh is not None and sp_axis in getattr(mesh, "axis_names", ()):
+        return P(dp_axis, sp_axis, None)
     return P(dp_axis, None, None)

@@ -88,7 +88,7 @@ def _layernorm(x, scale, bias):
     return (y * scale.astype(jnp.float32) + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _attention(qkv, config: ModelConfig):
+def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
     """qkv: [B, S, 3H] -> [B, S, H]."""
     if config.attention == "simplified":
         # reference's benchmarking shortcut: the query projection IS the
@@ -103,22 +103,33 @@ def _attention(qkv, config: ModelConfig):
         return t.reshape(b, s, n, d).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q), heads(k), heads(v)
-    logits = jnp.einsum("bnqd,bnkd->bnqk", q, k).astype(jnp.float32)
-    logits = logits / math.sqrt(d)
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    logits = jnp.where(mask, logits, -jnp.inf)
-    probs = jax.nn.softmax(logits, axis=-1).astype(qkv.dtype)
-    o = jnp.einsum("bnqk,bnkd->bnqd", probs, v)
+
+    if config.attention in ("ring", "ulysses"):
+        # sequence/context-parallel attention over the mesh's sp axis
+        if mesh is None or sp_axis not in mesh.axis_names:
+            raise ValueError(
+                f"attention={config.attention!r} needs a mesh with a "
+                f"{sp_axis!r} axis passed to forward()"
+            )
+        from dlbb_tpu.parallel import ring_attention, ulysses_attention
+
+        attn = ring_attention if config.attention == "ring" else ulysses_attention
+        o = attn(q, k, v, mesh, sp_axis=sp_axis)
+    else:
+        from dlbb_tpu.models.attention import dense_causal
+
+        o = dense_causal(q, k, v)
     return o.transpose(0, 2, 1, 3).reshape(b, s, n * d)
 
 
-def _block(x, layer: Params, config: ModelConfig):
+def _block(x, layer: Params, config: ModelConfig, mesh=None,
+           sp_axis: str = "sp"):
     """One transformer block (reference ``TransformerBlock.forward``
     ``models.py:147-190``)."""
     residual = x
     y = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
     qkv = y @ layer["qkv"]["kernel"] + layer["qkv"]["bias"]
-    attn = _attention(qkv, config)
+    attn = _attention(qkv, config, mesh, sp_axis)
     x = attn @ layer["out"]["kernel"] + layer["out"]["bias"] + residual
 
     residual = x
@@ -129,12 +140,17 @@ def _block(x, layer: Params, config: ModelConfig):
     return x
 
 
-def forward(params: Params, x: jax.Array, config: ModelConfig) -> jax.Array:
+def forward(params: Params, x: jax.Array, config: ModelConfig,
+            mesh=None, sp_axis: str = "sp") -> jax.Array:
     """Full forward pass: scan over stacked layers + final LN
-    (reference ``LLM.forward`` ``models.py:224-237``)."""
+    (reference ``LLM.forward`` ``models.py:224-237``).
+
+    ``mesh`` is required only for sequence-parallel attention modes
+    ("ring"/"ulysses"), whose shard_map needs the concrete mesh.
+    """
 
     def body(carry, layer):
-        return _block(carry, layer, config), None
+        return _block(carry, layer, config, mesh, sp_axis), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     return _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])

@@ -1,0 +1,22 @@
+"""Sequence/context parallelism.
+
+The reference scales sequence length only as a *payload dimension* (3D sweeps
+up to seq 8192, SURVEY §5.7) — it has no sequence-parallel attention.  A
+TPU-native long-context framework needs real context parallelism, so this
+package provides both standard schemes:
+
+- **ring attention** (``ring_attention``): KV blocks circulate the ICI ring
+  via ``lax.ppermute`` while each device accumulates flash-style online
+  softmax for its local query block — O(S/P) memory per device, comm
+  overlapped with compute by XLA.
+- **Ulysses** (``ulysses_attention``): ``lax.all_to_all`` reshards sequence
+  shards into head shards, runs dense local attention per head group, and
+  reshards back — 2 all-to-alls per layer, requires num_heads % sp == 0.
+
+Both are exact (tested against single-device dense attention) and causal.
+"""
+
+from dlbb_tpu.parallel.ring_attention import ring_attention
+from dlbb_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = ["ring_attention", "ulysses_attention"]

@@ -1,0 +1,120 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Each device owns one contiguous block of the sequence (queries stay put; key/
+value blocks travel the ring).  At ring step ``j`` a device holds the KV
+block originally owned by rank ``(rank - j) mod P``; it accumulates that
+block's contribution to its local queries with the numerically-stable online
+softmax (running max ``m``, normaliser ``l``, weighted accumulator ``acc`` —
+the flash-attention recurrence), then forwards the KV block to the next
+neighbour with ``lax.ppermute`` — which XLA lowers to neighbour ICI
+transfers, overlapping the DMA with the current block's matmuls.
+
+Causality is enforced through *global* positions (query block index is the
+device's axis rank, key block index is the travelling block's origin), so
+the result is bit-for-bit the causal attention of the unsharded sequence.
+
+Memory per device is O(S/P · d + (S/P)²) — the (S/P)² logits tile — versus
+O(S²) for dense attention, which is what makes million-token contexts
+feasible on a pod.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30  # finite mask value: avoids exp(-inf + inf) = nan in the
+# online-softmax rescale when a block is fully masked
+
+
+def _ring_body(q, k0, v0, axis_name: str, num_blocks: int, causal: bool):
+    """Local computation: q, k0, v0 are this device's blocks [B, n, Sl, d]."""
+    b, n, sl, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    my_block = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
+
+    q32 = q.astype(jnp.float32)
+    pos_q = my_block * sl + jnp.arange(sl)  # global query positions
+
+    def attend(j, k_cur, v_cur, m, l, acc):
+        """Accumulate ring-step-j's KV block into the online softmax."""
+        src = (my_block - j) % num_blocks  # origin rank of the current KV
+        logits = (
+            jnp.einsum("bnqd,bnkd->bnqk", q32, k_cur.astype(jnp.float32))
+            * scale
+        )
+        if causal:
+            pos_k = src * sl + jnp.arange(sl)
+            mask = pos_k[None, :] <= pos_q[:, None]  # [Sl_q, Sl_k]
+            logits = jnp.where(mask, logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bnqk,bnkd->bnqd", p, v_cur.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    def step(j, carry):
+        k_cur, v_cur, m, l, acc = carry
+        m, l, acc = attend(j, k_cur, v_cur, m, l, acc)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return k_next, v_next, m, l, acc
+
+    # accumulators derive from q so they carry q's shard_map varying-axes
+    # type (a constant init would be unvarying-in/varying-out, which the
+    # scan carry check rejects)
+    m0 = q32[..., 0] * 0.0 + _NEG_INF
+    l0 = q32[..., 0] * 0.0
+    acc0 = q32 * 0.0
+    # first num_blocks-1 steps attend-and-forward; the last block is consumed
+    # without a final ppermute (its result would be discarded)
+    k_last, v_last, m, l, acc = lax.fori_loop(
+        0, num_blocks - 1, step, (k0, v0, m0, l0, acc0)
+    )
+    m, l, acc = attend(num_blocks - 1, k_last, v_last, m, l, acc)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    sp_axis: str = "sp",
+    causal: bool = True,
+    batch_axes: Sequence[str] = ("dp",),
+) -> jax.Array:
+    """Exact causal attention with the sequence dim sharded over ``sp_axis``.
+
+    q, k, v: global ``[B, num_heads, S, head_dim]``; S must divide evenly
+    over the ``sp_axis`` mesh size.  Batch may additionally be sharded over
+    ``batch_axes`` (those present in the mesh).
+    """
+    if sp_axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh {mesh.axis_names} has no {sp_axis!r} axis for ring attention"
+        )
+    num_blocks = mesh.shape[sp_axis]
+    if q.shape[2] % num_blocks != 0:
+        raise ValueError(
+            f"sequence length {q.shape[2]} not divisible by sp={num_blocks}"
+        )
+    bspec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
+    spec = P(bspec, None, sp_axis, None)
+    fn = jax.shard_map(
+        lambda q_, k_, v_: _ring_body(q_, k_, v_, sp_axis, num_blocks, causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)

@@ -103,8 +103,9 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
     return recur(opt_state)
 
 
-def mse_loss(params, batch, targets, config: ModelConfig) -> jax.Array:
-    pred = forward(params, batch, config)
+def mse_loss(params, batch, targets, config: ModelConfig,
+             mesh: Optional[Mesh] = None) -> jax.Array:
+    pred = forward(params, batch, config, mesh=mesh)
     return jnp.mean(
         (pred.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2
     )
@@ -137,7 +138,7 @@ def make_train_step(
 
     def step(state: TrainState, batch, targets):
         loss, grads = jax.value_and_grad(mse_loss)(
-            state.params, batch, targets, config
+            state.params, batch, targets, config, mesh
         )
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -145,8 +146,8 @@ def make_train_step(
 
     jit_step = jax.jit(
         step,
-        in_shardings=(state_shardings, NamedSharding(mesh, batch_spec()),
-                      NamedSharding(mesh, batch_spec())),
+        in_shardings=(state_shardings, NamedSharding(mesh, batch_spec(mesh)),
+                      NamedSharding(mesh, batch_spec(mesh))),
         out_shardings=(state_shardings, NamedSharding(mesh, P())),
         donate_argnums=(0,),
     )
@@ -165,24 +166,34 @@ def run_train(
     par = config.get("parallelism", {})
     tp = par.get("world_size", 1)
     dp = par.get("data_parallel", 1)
+    sp = par.get("sequence_parallel", 1)
     n_avail = len(devices) if devices is not None else len(jax.devices())
-    if tp * dp > n_avail:
+    if tp * dp * sp > n_avail:
         raise ValueError(
-            f"config needs {tp * dp} devices (tp={tp} x dp={dp}), "
-            f"only {n_avail} available"
+            f"config needs {tp * dp * sp} devices (tp={tp} x dp={dp} x "
+            f"sp={sp}), only {n_avail} available"
         )
-    mesh = build_mesh(MeshSpec.grid((dp, tp), ("dp", "tp")), devices=devices)
+    if sp > 1:
+        spec = MeshSpec.grid((dp, sp, tp), ("dp", "sp", "tp"))
+    else:
+        spec = MeshSpec.grid((dp, tp), ("dp", "tp"))
+    mesh = build_mesh(spec, devices=devices)
 
     model_cfg = ModelConfig.from_dict(config["model"])
+    if model_cfg.attention in ("ring", "ulysses") and sp <= 1:
+        raise ValueError(
+            f"attention={model_cfg.attention!r} requires "
+            "parallelism.sequence_parallel > 1"
+        )
     inp = config["input"]
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
     data = SyntheticEmbeddingDataset(
         inp["batch_size"], inp["sequence_length"], model_cfg.hidden_size,
-        seed=inp.get("seed", 42), dtype=dtype, mesh=mesh, spec=batch_spec(),
+        seed=inp.get("seed", 42), dtype=dtype, mesh=mesh, spec=batch_spec(mesh),
     )
     targets = SyntheticEmbeddingDataset(
         inp["batch_size"], inp["sequence_length"], model_cfg.hidden_size,
-        seed=inp.get("seed", 42) + 1, dtype=dtype, mesh=mesh, spec=batch_spec(),
+        seed=inp.get("seed", 42) + 1, dtype=dtype, mesh=mesh, spec=batch_spec(mesh),
     )
 
     train_cfg = config.get("training", {})
@@ -241,7 +252,7 @@ def run_train(
         "experiment": config.get("experiment", {}),
         "backend": "xla_tpu",
         "mode": "zero1" if zero1 else "ddp",
-        "mesh": {"dp": dp, "tp": tp},
+        "mesh": {"dp": dp, "sp": sp, "tp": tp},
         "learning_rate": lr,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),

@@ -29,7 +29,7 @@ def _config(**over):
 
 def test_e2e_runs_and_writes_metrics(tmp_path, devices):
     result = run_e2e(_config(), output_dir=str(tmp_path), verbose=False)
-    assert result["mesh"] == {"dp": 2, "tp": 4}
+    assert result["mesh"] == {"dp": 2, "sp": 1, "tp": 4}
     assert result["forward_time"]["count"] == 3
     assert result["forward_time"]["mean"] > 0
     assert result["compile_time_s"] > 0
@@ -37,6 +37,35 @@ def test_e2e_runs_and_writes_metrics(tmp_path, devices):
     assert result["cross_host_variance"] == 0.0  # single process
     saved = json.loads((tmp_path / "xla_tpu_smoke.json").read_text())
     assert saved["model"]["num_parameters"] == result["model"]["num_parameters"]
+
+
+def test_e2e_sequence_parallel_ring(tmp_path, devices):
+    """E2E harness runs ring-attention context parallelism end-to-end
+    (sequence_parallel config knob; capability absent from the reference)."""
+    cfg = _config(
+        model={
+            "hidden_size": 64, "num_layers": 2, "num_heads": 4,
+            "ffn_intermediate": 128, "attention": "ring", "dtype": "float32",
+        },
+        parallelism={"world_size": 1, "data_parallel": 2,
+                     "sequence_parallel": 4},
+    )
+    result = run_e2e(cfg, verbose=False)
+    assert result["mesh"] == {"dp": 2, "sp": 4, "tp": 1}
+    assert result["forward_time"]["mean"] > 0
+
+
+def test_e2e_ring_requires_sp(devices):
+    cfg = _config(
+        model={
+            "hidden_size": 64, "num_layers": 1, "num_heads": 4,
+            "ffn_intermediate": 128, "attention": "ring",
+        },
+    )
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="sequence_parallel"):
+        run_e2e(cfg, verbose=False)
 
 
 def test_e2e_world_size_preflight(devices):
