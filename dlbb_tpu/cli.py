@@ -74,11 +74,27 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
     if getattr(args, "simulate", 0):
         from dlbb_tpu.utils.simulate import force_cpu_simulation
 
         force_cpu_simulation(args.simulate)
+    elif (
+        os.environ.get("DLBB_DISTRIBUTED") == "auto"
+        and args.cmd in ("bench1d", "bench3d", "e2e", "train")
+    ):
+        # pod launcher path (launch/launch_tpu_pod.sh): stand up
+        # jax.distributed across hosts before any backend use; stats
+        # subcommands are pure file processing and skip the handshake
+        from dlbb_tpu.comm.mesh import initialize_distributed
+
+        ctx = initialize_distributed(auto=True)
+        print(
+            f"[distributed] process {ctx.process_id}/{ctx.num_processes}, "
+            f"{ctx.num_devices} devices"
+        )
 
     if getattr(args, "variant", None) is not None:
         from dlbb_tpu.comm.variants import get_variant

@@ -155,6 +155,9 @@ def initialize_distributed(
             process_id=process_id,
         )
     elif auto:
+        # fail fast: auto=True means "we are on a pod" (launch_tpu_pod.sh);
+        # degrading one host to single-process while its peers initialize
+        # would hang the collective or silently mislabel single-host numbers
         jax.distributed.initialize()
     return DistributedContext(
         process_id=jax.process_index(),

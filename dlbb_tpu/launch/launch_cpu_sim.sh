@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# Development launcher on the CPU-simulated mesh — the analogue of the
+# reference's localhost rank sweeps (collectives/launch_openmpi.sh:5-12:
+# `for np in 2 4 8 16; do mpirun -np $np ...`).  One process, N fake devices.
+#
+# Usage:
+#   ./launch_cpu_sim.sh 8 bench1d --ranks 2 4 8
+#   ./launch_cpu_sim.sh 8 e2e --config dlbb_tpu/configs/baseline_config.yaml
+
+set -euo pipefail
+
+NDEV="${1:?usage: launch_cpu_sim.sh <num_devices> <subcommand> [args...]}"
+shift
+
+exec python -m dlbb_tpu.cli "$@" --simulate "$NDEV"

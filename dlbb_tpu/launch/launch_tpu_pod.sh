@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# TPU-pod launcher — the replacement for the reference's mpirun/deepspeed
+# launch layer (launch_openmpi.sh:19-26, collectives/3d/launch_dsccl.sh:69-74).
+#
+# On a TPU pod slice every host runs the same command; jax.distributed
+# auto-discovers the coordinator from the TPU metadata server (no -np / rank
+# tables needed — the analogue of mpirun's process spawning is the pod
+# runtime itself).
+#
+# Usage (run on every pod host, e.g. via `gcloud compute tpus tpu-vm ssh
+# --worker=all --command=...`):
+#   ./launch_tpu_pod.sh bench1d --ranks 8 16 --variant ring
+#   ./launch_tpu_pod.sh bench3d --ranks 16
+#   ./launch_tpu_pod.sh e2e --config dlbb_tpu/configs/baseline_config.yaml
+#
+# Tuning variants that carry XLA flags (see dlbb_tpu/comm/variants.py) must
+# have them set at process start; pass VARIANT_XLA_FLAGS:
+#   VARIANT_XLA_FLAGS="--xla_tpu_all_reduce_combine_threshold_bytes=4194304" \
+#     ./launch_tpu_pod.sh bench1d --variant combine4mb ...
+
+set -euo pipefail
+
+export XLA_FLAGS="${XLA_FLAGS:-} ${VARIANT_XLA_FLAGS:-}"
+export DLBB_DISTRIBUTED=auto   # dlbb_tpu.cli calls initialize_distributed(auto=True)
+
+exec python -m dlbb_tpu.cli "$@"
