@@ -18,8 +18,9 @@ class ModelConfig:
     num_layers: int
     num_heads: int
     ffn_intermediate: int
-    # "full" | "simplified" (reference parity) | "ring" | "ulysses"
-    # (sequence/context-parallel attention — dlbb_tpu.parallel)
+    # "full" | "simplified" (reference parity) | "flash" (pallas kernel,
+    # dlbb_tpu.ops) | "ring" | "ulysses" (sequence/context-parallel
+    # attention — dlbb_tpu.parallel)
     attention: str = "full"
     dtype: str = "bfloat16"
 
@@ -29,7 +30,8 @@ class ModelConfig:
                 f"hidden_size {self.hidden_size} not divisible by "
                 f"num_heads {self.num_heads}"
             )
-        if self.attention not in ("full", "simplified", "ring", "ulysses"):
+        if self.attention not in ("full", "simplified", "flash", "ring",
+                                  "ulysses"):
             raise ValueError(f"unknown attention mode {self.attention!r}")
 
     @property

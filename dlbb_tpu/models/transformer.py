@@ -115,6 +115,27 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
 
         attn = ring_attention if config.attention == "ring" else ulysses_attention
         o = attn(q, k, v, mesh, sp_axis=sp_axis)
+    elif config.attention == "flash":
+        from dlbb_tpu.ops import flash_attention
+
+        if mesh is not None and "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+            # pallas_call is opaque to GSPMD — without an explicit
+            # shard_map, jit would all-gather the head-sharded qkv and run
+            # the kernel replicated on every device.  Heads are independent,
+            # so map the kernel over the tp axis (and dp on batch if
+            # present); each device computes only its own heads.
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            dp = "dp" if "dp" in mesh.axis_names else None
+            spec = P(dp, "tp", None, None)
+            o = shard_map(
+                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,  # pallas_call declares no vma
+            )(q, k, v)
+        else:
+            o = flash_attention(q, k, v, causal=True)
     else:
         from dlbb_tpu.models.attention import dense_causal
 

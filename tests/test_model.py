@@ -42,9 +42,10 @@ def test_forward_shapes_and_dtype():
     assert np.isfinite(np.asarray(y)).all()
 
 
-@pytest.mark.parametrize("attention", ["full", "simplified"])
+@pytest.mark.parametrize("attention", ["full", "simplified", "flash"])
 def test_tp_matches_single_device(mesh2x4, attention):
-    """Sharded == unsharded, for both attention modes."""
+    """Sharded == unsharded, across attention modes (flash exercises the
+    shard_map-over-tp kernel dispatch)."""
     cfg = TINY.with_(attention=attention)
     params = init_params(cfg, jax.random.key(1))
     x = _batch(cfg)
@@ -52,7 +53,7 @@ def test_tp_matches_single_device(mesh2x4, attention):
 
     sharded = shard_params(params, mesh2x4)
     xs = jax.device_put(x, NamedSharding(mesh2x4, batch_spec()))
-    y_tp = jax.jit(lambda p, a: forward(p, a, cfg))(sharded, xs)
+    y_tp = jax.jit(lambda p, a: forward(p, a, cfg, mesh=mesh2x4))(sharded, xs)
     np.testing.assert_allclose(
         np.asarray(y_single), np.asarray(y_tp), rtol=2e-3, atol=2e-3
     )
