@@ -92,6 +92,13 @@ def run_e2e(
             f"attention={model_cfg.attention!r} requires "
             "parallelism.sequence_parallel > 1"
         )
+    if seq_parallel > 1 and model_cfg.attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"parallelism.sequence_parallel={seq_parallel} requires "
+            "attention='ring' or 'ulysses' "
+            f"(attention={model_cfg.attention!r} does not partition the "
+            "sequence; it would run replicated per sp shard)"
+        )
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
     params = init_params_sharded(

@@ -118,17 +118,30 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
     elif config.attention == "flash":
         from dlbb_tpu.ops import flash_attention
 
-        if mesh is not None and "tp" in mesh.axis_names and mesh.shape["tp"] > 1:
+        if mesh is not None and sp_axis in mesh.axis_names and mesh.shape[sp_axis] > 1:
+            raise ValueError(
+                "attention='flash' does not partition the sequence; use "
+                "attention='ring' or 'ulysses' when sequence_parallel > 1"
+            )
+        dp = (
+            "dp" if mesh is not None and "dp" in mesh.axis_names
+            and mesh.shape["dp"] > 1 else None
+        )
+        tp = (
+            "tp" if mesh is not None and "tp" in mesh.axis_names
+            and mesh.shape["tp"] > 1 else None
+        )
+        if dp is not None or tp is not None:
             # pallas_call is opaque to GSPMD — without an explicit
-            # shard_map, jit would all-gather the head-sharded qkv and run
-            # the kernel replicated on every device.  Heads are independent,
-            # so map the kernel over the tp axis (and dp on batch if
-            # present); each device computes only its own heads.
+            # shard_map, jit would all-gather the batch-(dp) and
+            # head-(tp) sharded qkv and run the kernel replicated on
+            # every device.  Batch entries and heads are independent, so
+            # map the kernel over whichever of (dp, tp) is actually
+            # sharded; each device computes only its own slice.
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
-            dp = "dp" if "dp" in mesh.axis_names else None
-            spec = P(dp, "tp", None, None)
+            spec = P(dp, tp, None, None)
             o = shard_map(
                 lambda q, k, v: flash_attention(q, k, v, causal=True),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

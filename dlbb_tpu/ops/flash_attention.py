@@ -6,7 +6,7 @@ S=8192 that is 4 GiB per head-batch in fp32 — while this kernel streams
 K/V blocks through VMEM and keeps only the ``[block_q, head_dim]``
 accumulator plus running max/sum on chip (the online-softmax recurrence).
 
-Design notes (see ``/opt/skills/guides/pallas_guide.md``):
+Design notes (standard blocked-attention scheme: Dao et al., FlashAttention-2):
 
 - grid ``(B*N, S/block_q, S/block_k)`` — the K dimension is innermost, so
   the VMEM scratch accumulator persists across K iterations of one Q row;
@@ -182,6 +182,19 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     )(q, k, v)
 
 
+def _p_from_lse(s, lse_row):
+    """Recompute probabilities ``exp(s - lse)`` for the backward kernels.
+
+    Fully-masked query rows (causal with ``offset < 0``, i.e. ``sk < s``)
+    carry ``lse = NEG_INF``; there ``s - lse = NEG_INF - NEG_INF = 0`` would
+    yield p = 1 across the whole block and inject garbage into dq/dk/dv.
+    Such rows produced o = 0 in the forward, so their true gradient
+    contribution is 0 — force p to 0.
+    """
+    p = jnp.exp(s - lse_row)
+    return jnp.where(lse_row <= NEG_INF / 2, 0.0, p)
+
+
 # ---------------------------------------------------------------------------
 # backward — recompute p blockwise from (q, k, lse); two passes:
 #   dq kernel:  grid over Q blocks (outer), K blocks inner — accumulates dq;
@@ -210,7 +223,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = _masked_scores(q_ref[0], k, qi, ki, sm_scale=sm_scale,
                            block_q=block_q, block_k=block_k, causal=causal,
                            offset=offset)
-        p = jnp.exp(s - lse_ref[0][:, :1])                      # [bq, bk]
+        p = _p_from_lse(s, lse_ref[0][:, :1])                   # [bq, bk]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -247,7 +260,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = _masked_scores(q, k_ref[0], qi, ki, sm_scale=sm_scale,
                            block_q=block_q, block_k=block_k, causal=causal,
                            offset=offset)
-        p = jnp.exp(s - lse_ref[0][:, :1])                      # [bq, bk]
+        p = _p_from_lse(s, lse_ref[0][:, :1])                   # [bq, bk]
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,

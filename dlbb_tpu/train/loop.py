@@ -185,6 +185,13 @@ def run_train(
             f"attention={model_cfg.attention!r} requires "
             "parallelism.sequence_parallel > 1"
         )
+    if sp > 1 and model_cfg.attention not in ("ring", "ulysses"):
+        raise ValueError(
+            f"parallelism.sequence_parallel={sp} requires "
+            "attention='ring' or 'ulysses' "
+            f"(attention={model_cfg.attention!r} does not partition the "
+            "sequence; it would run replicated per sp shard)"
+        )
     inp = config["input"]
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
     data = SyntheticEmbeddingDataset(
@@ -204,6 +211,19 @@ def run_train(
         model_cfg, jax.random.key(inp.get("seed", 42)), mesh
     )
     jit_step, state = make_train_step(model_cfg, mesh, optimizer, params, zero1)
+
+    # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see
+    # dlbb_tpu/train/checkpoint.py).  Resume happens before warmup so the
+    # restored step counter carries through the run.
+    ckpt = None
+    resumed_from = None
+    if train_cfg.get("checkpoint", {}).get("enabled", True) \
+            and "checkpoint" in train_cfg:
+        from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+        ckpt = Checkpointer(CheckpointConfig.from_dict(train_cfg["checkpoint"]))
+        resumed_from = ckpt.latest_step()
+        state = ckpt.restore_or(state)
 
     execution = config.get("execution", {})
     warmup = execution.get("warmup_iterations", 2)
@@ -228,6 +248,8 @@ def run_train(
             jax.block_until_ready(loss)
             step_times.append(time.perf_counter() - t0)
             losses.append(float(loss))
+            if ckpt is not None:
+                ckpt.maybe_save(state)
         timing_meta = {
             "timing_mode": "per_iter",
             "timing_method": "time.perf_counter() + jax.block_until_ready()",
@@ -238,6 +260,8 @@ def run_train(
         for _ in range(iters):
             state, loss = jit_step(state, batch, tgt)
             losses.append(float(loss))
+            if ckpt is not None:
+                ckpt.maybe_save(state)
 
         def timed_step(b, t, st):
             new_state, _ = jit_step(st, b, t)
@@ -248,10 +272,15 @@ def run_train(
             chunk_size=min(5, iters), op_args=(batch, tgt),
         )
 
+    if ckpt is not None:
+        ckpt.maybe_save(state, force=True)
+        ckpt.close()
+
     result = {
         "experiment": config.get("experiment", {}),
         "backend": "xla_tpu",
         "mode": "zero1" if zero1 else "ddp",
+        "resumed_from_step": resumed_from,
         "mesh": {"dp": dp, "sp": sp, "tp": tp},
         "learning_rate": lr,
         "compile_time_s": compile_time,

@@ -122,3 +122,88 @@ def test_flash_tp_shard_map_matches_unsharded(mesh2x4):
     ref = flash_attention(q, k, v)
     np.testing.assert_allclose(np.asarray(out_sharded), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_grads_fully_masked_rows_zero():
+    """sk < s with causal: rows r with r + (sk - s) < 0 attend to nothing —
+    forward emits zeros there and the backward must emit zero gradients
+    (regression: p = exp(NEG_INF - NEG_INF) = 1 injected garbage)."""
+    b, n, s, d = 1, 2, 128, 64
+    sk = 64  # rows 0..63 are fully masked (offset = -64)
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (b, n, s, d))
+    k = jax.random.normal(ks[1], (b, n, sk, d))
+    v = jax.random.normal(ks[2], (b, n, sk, d))
+
+    out = flash_attention(q, k, v, block_q=64, block_k=64)
+    np.testing.assert_array_equal(np.asarray(out[:, :, :64]), 0.0)
+
+    def dense_ref(q, k, v):
+        dd = q.shape[-1]
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q, k) / jnp.sqrt(jnp.float32(dd))
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(sk)[None, :]
+        mask = rows + (sk - s) >= cols
+        logits = jnp.where(mask, logits, -jnp.inf)
+        p = jax.nn.softmax(logits, -1)
+        p = jnp.where(jnp.any(mask, -1, keepdims=True), p, 0.0)
+        return jnp.einsum("bnqk,bnkd->bnqd", p, v)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=64, block_k=64) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(dense_ref(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    # masked q rows get exactly zero gradient
+    np.testing.assert_array_equal(np.asarray(g_flash[0][:, :, :64]), 0.0)
+    for gf, gd, name in zip(g_flash, g_dense, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gf), np.asarray(gd), atol=1e-4, rtol=1e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_dp_only_mesh_no_allgather(devices):
+    """On a dp-only mesh, flash attention must go through shard_map so the
+    batch stays sharded — the compiled forward contains no all-gather
+    (regression: bare pallas_call made GSPMD replicate the batch)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.models.transformer import forward, init_params
+
+    mesh = build_mesh(MeshSpec.grid((8,), ("dp",)))
+    cfg = ModelConfig(hidden_size=128, num_layers=1, num_heads=2,
+                      ffn_intermediate=256, attention="flash", dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(1), (8, 128, 128)),
+        NamedSharding(mesh, P("dp")),
+    )
+    lowered = jax.jit(lambda p, x: forward(p, x, cfg, mesh=mesh)).lower(params, x)
+    hlo = lowered.compile().as_text()
+    assert "all-gather" not in hlo, "dp-sharded flash forward all-gathers"
+
+    # and numerics still match the unsharded run
+    out = jax.jit(lambda p, x: forward(p, x, cfg, mesh=mesh))(params, x)
+    ref = forward(params, x, cfg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-4, rtol=1e-4)
+
+
+def test_flash_rejects_sequence_parallel_mesh(devices):
+    from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.models.transformer import forward, init_params
+
+    mesh = build_mesh(MeshSpec.grid((4, 2), ("sp", "tp")))
+    cfg = ModelConfig(hidden_size=64, num_layers=1, num_heads=2,
+                      ffn_intermediate=128, attention="flash", dtype="float32")
+    params = init_params(cfg, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (2, 64, 64))
+    with pytest.raises(ValueError, match="ring"):
+        forward(params, x, cfg, mesh=mesh)
