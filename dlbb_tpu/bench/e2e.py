@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
-from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.models.configs import ModelConfig, validate_attention_parallelism
 from dlbb_tpu.models.sharding import batch_spec
 from dlbb_tpu.models.transformer import (
     forward,
@@ -37,6 +37,7 @@ from dlbb_tpu.models.transformer import (
 )
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import summarize
+from dlbb_tpu.utils.profiling import annotate
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import (
     force_completion,
@@ -87,18 +88,7 @@ def run_e2e(
 
     mesh = build_e2e_mesh(world_size, data_parallel, seq_parallel, devices)
     model_cfg = ModelConfig.from_dict(config["model"])
-    if model_cfg.attention in ("ring", "ulysses") and "sp" not in mesh.axis_names:
-        raise ValueError(
-            f"attention={model_cfg.attention!r} requires "
-            "parallelism.sequence_parallel > 1"
-        )
-    if seq_parallel > 1 and model_cfg.attention not in ("ring", "ulysses"):
-        raise ValueError(
-            f"parallelism.sequence_parallel={seq_parallel} requires "
-            "attention='ring' or 'ulysses' "
-            f"(attention={model_cfg.attention!r} does not partition the "
-            "sequence; it would run replicated per sp shard)"
-        )
+    validate_attention_parallelism(model_cfg, seq_parallel)
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
     params = init_params_sharded(
@@ -132,23 +122,26 @@ def run_e2e(
     # backends feeds the output straight back as the next input.
     mode = resolve_timing_mode("auto")
 
-    t0 = time.perf_counter()
-    force_completion(step(params, batch))
-    compile_time = time.perf_counter() - t0
+    with annotate("compile+warmup"):
+        t0 = time.perf_counter()
+        force_completion(step(params, batch))
+        compile_time = time.perf_counter() - t0
 
-    if mode == "per_iter":
-        forward_times = time_fn_per_iter(
-            step, params, batch, warmup=max(0, warmup - 1), iterations=iters
-        )
-        timing_meta = {
-            "timing_mode": "per_iter",
-            "timing_method": "time.perf_counter() + jax.block_until_ready()",
-        }
-    else:
-        forward_times, timing_meta = time_fn_chained(
-            step, batch, warmup=1, iterations=iters,
-            chunk_size=min(5, iters), op_args=(params,),
-        )
+    with annotate("measure"):
+        if mode == "per_iter":
+            forward_times = time_fn_per_iter(
+                step, params, batch, warmup=max(0, warmup - 1),
+                iterations=iters
+            )
+            timing_meta = {
+                "timing_mode": "per_iter",
+                "timing_method": "time.perf_counter() + jax.block_until_ready()",
+            }
+        else:
+            forward_times, timing_meta = time_fn_chained(
+                step, batch, warmup=1, iterations=iters,
+                chunk_size=min(5, iters), op_args=(params,),
+            )
 
     # cross-host spread of mean forward time (run_mpi.py:199-212 analogue)
     local_mean = float(np.mean(forward_times))

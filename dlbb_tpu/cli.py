@@ -28,6 +28,13 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--output", default=None, help="output directory for result JSONs")
     p.add_argument("--simulate", type=int, default=0, metavar="N",
                    help="use an N-device CPU-simulated mesh (dev path)")
+    _add_trace(p)
+
+
+def _add_trace(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="write an XLA profiler trace (xplane) to DIR; "
+                        "DLBB_TRACE_DIR env is the default")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -63,12 +70,14 @@ def build_parser() -> argparse.ArgumentParser:
     e2.add_argument("--config", required=True, help="YAML experiment config")
     e2.add_argument("--simulate", type=int, default=0, metavar="N")
     e2.add_argument("--output", default=None)
+    _add_trace(e2)
 
     tr = sub.add_parser("train", help="DDP/ZeRO-1 training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
     tr.add_argument("--simulate", type=int, default=0, metavar="N")
     tr.add_argument("--zero1", action="store_true", help="shard optimizer state (ZeRO-1)")
     tr.add_argument("--output", default=None)
+    _add_trace(tr)
 
     return ap
 
@@ -105,6 +114,20 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {e.args[0]}")
             return 2
 
+    if args.cmd in ("bench1d", "bench3d", "e2e", "train"):
+        # stats subcommands are pure numpy file processing — no backend,
+        # no profiler, and no jax import even when DLBB_TRACE_DIR is set
+        from dlbb_tpu.utils.profiling import maybe_trace
+
+        with maybe_trace(getattr(args, "trace", None)) as trace_dir:
+            rc = _dispatch(args)
+        if trace_dir:
+            print(f"[trace] xplane trace written to {trace_dir}")
+        return rc
+    return _dispatch(args)
+
+
+def _dispatch(args) -> int:
     if args.cmd == "bench1d":
         from dlbb_tpu.bench import (
             DATA_SIZES_1D,

@@ -62,6 +62,29 @@ class ModelConfig:
         return cls(**fields)
 
 
+# Attention modes that actually partition the sequence dimension over an
+# sp mesh axis.  Single source of truth for config validation (harnesses)
+# and the mesh-level guard in transformer._attention.
+SP_CAPABLE_ATTENTION = ("ring", "ulysses")
+
+
+def validate_attention_parallelism(config: ModelConfig, sp: int) -> None:
+    """Reject attention-mode / sequence-parallel combinations that would
+    silently compute the wrong thing or replicate work per sp shard."""
+    if config.attention in SP_CAPABLE_ATTENTION and sp <= 1:
+        raise ValueError(
+            f"attention={config.attention!r} requires "
+            "parallelism.sequence_parallel > 1"
+        )
+    if sp > 1 and config.attention not in SP_CAPABLE_ATTENTION:
+        raise ValueError(
+            f"parallelism.sequence_parallel={sp} requires attention in "
+            f"{SP_CAPABLE_ATTENTION} (attention={config.attention!r} does "
+            "not partition the sequence; it would run replicated per sp "
+            "shard)"
+        )
+
+
 # Reference sizes (``models.py:252-271``).
 MODEL_CONFIGS: dict[str, ModelConfig] = {
     "1B": ModelConfig(hidden_size=2048, num_layers=24, num_heads=16,

@@ -32,11 +32,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
-from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.models.configs import ModelConfig, validate_attention_parallelism
 from dlbb_tpu.models.sharding import batch_spec, param_specs
 from dlbb_tpu.models.transformer import forward, init_params_sharded
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import summarize
+from dlbb_tpu.utils.profiling import annotate, step_annotation
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import resolve_timing_mode, time_fn_chained
 
@@ -180,18 +181,7 @@ def run_train(
     mesh = build_mesh(spec, devices=devices)
 
     model_cfg = ModelConfig.from_dict(config["model"])
-    if model_cfg.attention in ("ring", "ulysses") and sp <= 1:
-        raise ValueError(
-            f"attention={model_cfg.attention!r} requires "
-            "parallelism.sequence_parallel > 1"
-        )
-    if sp > 1 and model_cfg.attention not in ("ring", "ulysses"):
-        raise ValueError(
-            f"parallelism.sequence_parallel={sp} requires "
-            "attention='ring' or 'ulysses' "
-            f"(attention={model_cfg.attention!r} does not partition the "
-            "sequence; it would run replicated per sp shard)"
-        )
+    validate_attention_parallelism(model_cfg, sp)
     inp = config["input"]
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
     data = SyntheticEmbeddingDataset(
@@ -217,8 +207,8 @@ def run_train(
     # restored step counter carries through the run.
     ckpt = None
     resumed_from = None
-    if train_cfg.get("checkpoint", {}).get("enabled", True) \
-            and "checkpoint" in train_cfg:
+    if "checkpoint" in train_cfg \
+            and train_cfg["checkpoint"].get("enabled", True):
         from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
 
         ckpt = Checkpointer(CheckpointConfig.from_dict(train_cfg["checkpoint"]))
@@ -231,22 +221,24 @@ def run_train(
     mode = resolve_timing_mode("auto")
 
     batch, tgt = data.get_batch(), targets.get_batch()
-    t0 = time.perf_counter()
-    state, loss = jit_step(state, batch, tgt)
-    float(loss)  # forces completion on any backend
-    compile_time = time.perf_counter() - t0
-    for _ in range(max(0, warmup - 1)):
+    with annotate("compile+warmup"):
+        t0 = time.perf_counter()
         state, loss = jit_step(state, batch, tgt)
         float(loss)  # forces completion on any backend
+        compile_time = time.perf_counter() - t0
+        for _ in range(max(0, warmup - 1)):
+            state, loss = jit_step(state, batch, tgt)
+            float(loss)  # forces completion on any backend
 
     losses = []
     if mode == "per_iter":
         step_times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            state, loss = jit_step(state, batch, tgt)
-            jax.block_until_ready(loss)
-            step_times.append(time.perf_counter() - t0)
+        for i in range(iters):
+            with step_annotation("train_step", i):
+                t0 = time.perf_counter()
+                state, loss = jit_step(state, batch, tgt)
+                jax.block_until_ready(loss)
+                step_times.append(time.perf_counter() - t0)
             losses.append(float(loss))
             if ckpt is not None:
                 ckpt.maybe_save(state)
@@ -267,10 +259,11 @@ def run_train(
             new_state, _ = jit_step(st, b, t)
             return new_state
 
-        step_times, timing_meta = time_fn_chained(
-            timed_step, state, warmup=1, iterations=iters,
-            chunk_size=min(5, iters), op_args=(batch, tgt),
-        )
+        with annotate("measure"):
+            step_times, timing_meta = time_fn_chained(
+                timed_step, state, warmup=1, iterations=iters,
+                chunk_size=min(5, iters), op_args=(batch, tgt),
+            )
 
     if ckpt is not None:
         ckpt.maybe_save(state, force=True)

@@ -1,0 +1,71 @@
+"""Tracing / profiling subsystem.
+
+The reference has no in-repo profiler — its observability is wall-clock
+timers plus *library* debug tracing switched on via env vars
+(``CCL_LOG_LEVEL=debug``, ``I_MPI_DEBUG=10``, ``mpirun --report-bindings``;
+reference ``collectives/3d/launch_dsccl.sh:34``,
+``collectives/3d/launch_mpiccl.sh:12,17-18``).  The TPU-native equivalent is
+the XLA profiler: ``jax.profiler`` emits xplane traces (per-op device
+timelines, HLO cost analysis, memory viewer) viewable in TensorBoard or
+Perfetto — strictly more information than the reference's text logs.
+
+Surface, mirroring the reference's env-switched design:
+
+- ``maybe_trace(trace_dir)`` — context manager; no-op when ``trace_dir`` is
+  None/empty.  ``DLBB_TRACE_DIR`` env is the default, so any benchmark can
+  be traced without changing its invocation (the CCL_LOG_LEVEL analogue).
+- ``annotate(name)`` — host-side named region (``TraceAnnotation``) so
+  warmup/measurement phases are distinguishable in the timeline.
+- ``step_annotation(name, step)`` — per-step annotation for training loops.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+# jax is imported inside each function: the stats subcommands are
+# numpy-only by design (cli.py lazy-imports per branch) and must not pay
+# the jax import just because this module is on their import path.
+
+__all__ = ["maybe_trace", "annotate", "step_annotation", "default_trace_dir"]
+
+
+def default_trace_dir() -> Optional[str]:
+    """The env-switched default (``DLBB_TRACE_DIR``), or None."""
+    return os.environ.get("DLBB_TRACE_DIR") or None
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str] = None) -> Iterator[Optional[str]]:
+    """Trace everything inside the block to ``trace_dir`` (xplane format).
+
+    ``trace_dir=None`` falls back to ``DLBB_TRACE_DIR``; if that is unset
+    too, the block runs untraced at zero cost.  Yields the resolved trace
+    directory (or None) so callers can record it in result metadata.
+    """
+    trace_dir = trace_dir or default_trace_dir()
+    if not trace_dir:
+        yield None
+        return
+    import jax
+
+    os.makedirs(trace_dir, exist_ok=True)
+    with jax.profiler.trace(trace_dir):
+        yield trace_dir
+
+
+def annotate(name: str):
+    """Named host-side region, visible in the trace timeline."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def step_annotation(name: str, step: int):
+    """Per-step region for training/benchmark loops (groups device ops
+    under one step in the trace viewer)."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)
