@@ -72,10 +72,14 @@ def build_parser() -> argparse.ArgumentParser:
     e2.add_argument("--output", default=None)
     _add_trace(e2)
 
-    tr = sub.add_parser("train", help="DDP/ZeRO-1 training-loop benchmark")
+    tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
     tr.add_argument("--simulate", type=int, default=0, metavar="N")
     tr.add_argument("--zero1", action="store_true", help="shard optimizer state (ZeRO-1)")
+    tr.add_argument("--zero", type=int, default=None, choices=(0, 1, 2, 3),
+                    metavar="STAGE", dest="zero_stage",
+                    help="ZeRO stage: 0=DDP, 1=opt-state sharding, "
+                         "2=+grad reduce-scatter, 3=FSDP param sharding")
     tr.add_argument("--output", default=None)
     _add_trace(tr)
 
@@ -219,7 +223,8 @@ def _dispatch(args) -> int:
             return 2
 
         result = run_train_from_config(
-            args.config, zero1=args.zero1, output_dir=args.output
+            args.config, zero1=args.zero1, zero_stage=args.zero_stage,
+            output_dir=args.output,
         )
         print(f"step mean {result['step_time']['mean'] * 1e3:.2f} ms")
         return 0

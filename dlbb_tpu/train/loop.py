@@ -1,19 +1,29 @@
-"""DDP / ZeRO-1 training loop.
+"""DDP / ZeRO-{1,2,3} training loop.
 
 The reference's training capability is a DeepSpeed smoke: ZeRO-2 engine init,
 MSE loss, ``backward()`` (gradient all-reduce / reduce-scatter) and
 ``step()`` (``test/ccl.py:59-117``), plus ZeRO-0 + Adam (``test/ds_mpi_test.py``).
-TPU-native re-design:
+TPU-native re-design — every stage is a *sharding declaration*, not a
+hand-written collective schedule:
 
-- **DDP**: batch sharded over the ``dp`` mesh axis, params replicated over
-  ``dp`` (and TP-sharded over ``tp``); the gradient all-reduce the reference
-  delegates to DeepSpeed/oneCCL is inserted by XLA GSPMD because the loss
-  mean contracts a dp-sharded batch against dp-replicated params.
+- **DDP (stage 0)**: batch sharded over the ``dp`` mesh axis, params
+  replicated over ``dp`` (and TP-sharded over ``tp``); the gradient
+  all-reduce the reference delegates to DeepSpeed/oneCCL is inserted by XLA
+  GSPMD because the loss mean contracts a dp-sharded batch against
+  dp-replicated params.
 - **ZeRO-1**: optimizer state (Adam mu/nu) sharded over ``dp`` on top of the
   TP layout.  Declaring sharded out-shardings for the optimizer state makes
   XLA lower the grad all-reduce into reduce-scatter + sharded update +
   all-gather of the new params — the ZeRO-1 dataflow of
   BASELINE.json config 5 — without hand-written collectives.
+- **ZeRO-2**: additionally pins the *gradients* to the dp-sharded layout with
+  a sharding constraint, so the backward's grad buffers are reduce-scattered
+  as they are produced (sharded grad memory — DeepSpeed stage-2 semantics,
+  the config at reference ``test/ccl.py:86-89``).
+- **ZeRO-3 / FSDP**: the parameters themselves live dp-sharded; XLA inserts
+  the per-layer all-gathers on use in forward/backward and frees the
+  gathered copies after — DeepSpeed stage-3 dataflow, declared in one spec
+  tree.
 - Adam via optax; MSE loss vs a fixed target batch (parity with
   ``test/ccl.py:110``).
 """
@@ -52,11 +62,16 @@ def _is_spec(x) -> bool:
     return isinstance(x, P)
 
 
-def _zero1_spec(spec: P, shape: tuple[int, ...], dp_size: int,
-                dp_axis: str = "dp") -> P:
+def _dp_shard_spec(spec: P, shape: tuple[int, ...], dp_size: int,
+                   dp_axis: str = "dp") -> P:
     """Add a ``dp`` sharding to ``spec`` on the largest unsharded,
-    dp-divisible axis (ZeRO-1 optimizer-state partitioning)."""
+    dp-divisible axis (ZeRO optimizer-state / gradient / FSDP-param
+    partitioning).  No-op when ``spec`` already uses ``dp`` or no axis
+    divides evenly."""
     parts = list(spec) + [None] * (len(shape) - len(spec))
+    if any(dp_axis in (ax if isinstance(ax, tuple) else (ax,))
+           for ax in parts if ax is not None):
+        return spec
     candidates = sorted(
         (i for i in range(len(shape))
          if parts[i] is None and shape[i] % dp_size == 0 and shape[i] > 1),
@@ -66,6 +81,17 @@ def _zero1_spec(spec: P, shape: tuple[int, ...], dp_size: int,
         return spec
     parts[candidates[0]] = dp_axis
     return P(*parts)
+
+
+def dp_sharded_param_specs(params: Any, dp_size: int,
+                           dp_axis: str = "dp") -> Any:
+    """The TP spec tree with a ``dp`` sharding added per leaf — the FSDP /
+    ZeRO-3 parameter layout, also the ZeRO-{1,2} optimizer-state/grad
+    layout."""
+    return jax.tree.map(
+        lambda s, p: _dp_shard_spec(s, p.shape, dp_size, dp_axis),
+        param_specs(), params, is_leaf=_is_spec,
+    )
 
 
 def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
@@ -79,9 +105,8 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
     Everything else (step counts, empty states) stays replicated.
     """
     p_def = jax.tree.structure(params)
-    spec_for_params = jax.tree.map(
-        lambda s, p: _zero1_spec(s, p.shape, dp_size) if zero1 else s,
-        param_specs(), params, is_leaf=_is_spec,
+    spec_for_params = (
+        dp_sharded_param_specs(params, dp_size) if zero1 else param_specs()
     )
 
     def recur(node):
@@ -112,21 +137,40 @@ def mse_loss(params, batch, targets, config: ModelConfig,
     )
 
 
+def resolve_zero_stage(zero1: bool = False,
+                       zero_stage: Optional[int] = None) -> int:
+    """Collapse the legacy ``zero1`` flag and the explicit ``zero_stage``
+    into one stage number 0-3."""
+    if zero_stage is not None:
+        if zero_stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero_stage must be 0-3, got {zero_stage}")
+        return zero_stage
+    return 1 if zero1 else 0
+
+
+MODE_NAMES = {0: "ddp", 1: "zero1", 2: "zero2", 3: "zero3"}
+
+
 def make_train_step(
     config: ModelConfig,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
     params: Any,
     zero1: bool = False,
+    zero_stage: Optional[int] = None,
 ):
-    """Build (jitted step fn, initial sharded TrainState)."""
+    """Build (jitted step fn, initial sharded TrainState) for the given
+    ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP)."""
+    stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
+    dp_specs = dp_sharded_param_specs(params, dp_size)
+    p_spec_tree = dp_specs if stage >= 3 else param_specs()
     p_shardings = jax.tree.map(
-        lambda s: NamedSharding(mesh, s), param_specs(), is_leaf=_is_spec
+        lambda s: NamedSharding(mesh, s), p_spec_tree, is_leaf=_is_spec
     )
     params = jax.device_put(params, p_shardings)
     opt_state = optimizer.init(params)
-    s_specs = opt_state_specs(params, opt_state, zero1, dp_size)
+    s_specs = opt_state_specs(params, opt_state, stage >= 1, dp_size)
     s_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), s_specs, is_leaf=_is_spec
     )
@@ -136,11 +180,18 @@ def make_train_step(
     state_shardings = TrainState(
         p_shardings, s_shardings, NamedSharding(mesh, P())
     )
+    grad_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), dp_specs, is_leaf=_is_spec
+    )
 
     def step(state: TrainState, batch, targets):
         loss, grads = jax.value_and_grad(mse_loss)(
             state.params, batch, targets, config, mesh
         )
+        if stage >= 2:
+            # pin grads to the dp-sharded layout: the dp all-reduce lowers
+            # to reduce-scatter and grad memory stays sharded (ZeRO-2)
+            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
         updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         return TrainState(new_params, new_opt, state.step + 1), loss
@@ -158,6 +209,7 @@ def make_train_step(
 def run_train(
     config: dict[str, Any],
     zero1: bool = False,
+    zero_stage: Optional[int] = None,
     devices: Optional[Sequence] = None,
     output_dir: Optional[str] = None,
     verbose: bool = True,
@@ -165,6 +217,11 @@ def run_train(
     """Config-driven training benchmark (the train-side analogue of the E2E
     forward harness; reference flow ``test/ccl.py:59-117``)."""
     par = config.get("parallelism", {})
+    # explicit caller args (zero_stage or legacy zero1) win over the config
+    if zero_stage is None and not zero1 \
+            and "zero_stage" in config.get("training", {}):
+        zero_stage = config["training"]["zero_stage"]
+    stage = resolve_zero_stage(zero1, zero_stage)
     tp = par.get("world_size", 1)
     dp = par.get("data_parallel", 1)
     sp = par.get("sequence_parallel", 1)
@@ -200,7 +257,9 @@ def run_train(
     params = init_params_sharded(
         model_cfg, jax.random.key(inp.get("seed", 42)), mesh
     )
-    jit_step, state = make_train_step(model_cfg, mesh, optimizer, params, zero1)
+    jit_step, state = make_train_step(
+        model_cfg, mesh, optimizer, params, zero_stage=stage
+    )
 
     # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see
     # dlbb_tpu/train/checkpoint.py).  Resume happens before warmup so the
@@ -272,7 +331,8 @@ def run_train(
     result = {
         "experiment": config.get("experiment", {}),
         "backend": "xla_tpu",
-        "mode": "zero1" if zero1 else "ddp",
+        "mode": MODE_NAMES[stage],
+        "zero_stage": stage,
         "resumed_from_step": resumed_from,
         "mesh": {"dp": dp, "sp": sp, "tp": tp},
         "learning_rate": lr,
@@ -299,9 +359,11 @@ def run_train(
 def run_train_from_config(
     config_path: str,
     zero1: bool = False,
+    zero_stage: Optional[int] = None,
     output_dir: Optional[str] = None,
     devices: Optional[Sequence] = None,
 ) -> dict[str, Any]:
     config = load_config(config_path)
     out = output_dir or config.get("experiment", {}).get("output_dir")
-    return run_train(config, zero1=zero1, devices=devices, output_dir=out)
+    return run_train(config, zero1=zero1, zero_stage=zero_stage,
+                     devices=devices, output_dir=out)

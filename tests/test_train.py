@@ -1,4 +1,4 @@
-"""Training-loop tests: DDP + ZeRO-1 on the simulated (dp, tp) mesh
+"""Training-loop tests: DDP + ZeRO-{1,2,3} on the simulated (dp, tp) mesh
 (reference's training capability: ``test/ccl.py:59-117`` ZeRO train step)."""
 
 import jax
@@ -12,7 +12,12 @@ from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
 from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.models.sharding import batch_spec
 from dlbb_tpu.models.transformer import init_params
-from dlbb_tpu.train.loop import make_train_step, opt_state_specs, run_train
+from dlbb_tpu.train.loop import (
+    make_train_step,
+    opt_state_specs,
+    resolve_zero_stage,
+    run_train,
+)
 
 TINY = ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
                    ffn_intermediate=64, attention="full", dtype="float32")
@@ -75,6 +80,64 @@ def test_zero1_matches_ddp_numerics(devices):
     np.testing.assert_allclose(
         r_ddp["losses"], r_z1["losses"], rtol=1e-4, atol=1e-5
     )
+
+
+def _dp_sharded_leaves(tree):
+    count = 0
+    for leaf in jax.tree.leaves(tree):
+        sharding = leaf.sharding
+        if isinstance(sharding, NamedSharding) and any(
+            "dp" in (ax if isinstance(ax, tuple) else (ax,))
+            for ax in sharding.spec if ax is not None
+        ):
+            count += 1
+    return count
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+def test_zero23_matches_ddp_numerics(devices, stage):
+    """Sharding grads (stage 2) or params (stage 3) must not change the
+    optimisation trajectory."""
+    r_ddp = run_train(_config(), zero_stage=0, verbose=False)
+    r_z = run_train(_config(), zero_stage=stage, verbose=False)
+    assert r_z["mode"] == f"zero{stage}"
+    np.testing.assert_allclose(
+        r_ddp["losses"], r_z["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_zero3_shards_params(devices):
+    """ZeRO-3/FSDP: the parameters themselves must live dp-sharded;
+    stages <=2 keep them dp-replicated."""
+    mesh = build_mesh(MeshSpec.grid((4, 2), ("dp", "tp")))
+    params = init_params(TINY, jax.random.key(0))
+    opt = optax.adam(1e-3)
+
+    _, state_z2 = make_train_step(TINY, mesh, opt, params, zero_stage=2)
+    _, state_z3 = make_train_step(TINY, mesh, opt, params, zero_stage=3)
+
+    assert _dp_sharded_leaves(state_z2.params) == 0
+    assert _dp_sharded_leaves(state_z3.params) > 0
+    # opt state is dp-sharded in both
+    assert _dp_sharded_leaves(state_z2.opt_state) > 0
+    assert _dp_sharded_leaves(state_z3.opt_state) > 0
+
+
+def test_zero_stage_config_key(devices):
+    """training.zero_stage in the YAML config selects the stage."""
+    cfg = _config()
+    cfg["training"]["zero_stage"] = 2
+    result = run_train(cfg, verbose=False)
+    assert result["mode"] == "zero2"
+    assert result["zero_stage"] == 2
+
+
+def test_resolve_zero_stage():
+    assert resolve_zero_stage() == 0
+    assert resolve_zero_stage(zero1=True) == 1
+    assert resolve_zero_stage(zero1=True, zero_stage=3) == 3
+    with pytest.raises(ValueError):
+        resolve_zero_stage(zero_stage=4)
 
 
 def test_opt_state_specs_scalar_replicated(devices):
