@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+from dlbb_tpu.comm.mesh import build_parallelism_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
 from dlbb_tpu.models.configs import ModelConfig, validate_attention_parallelism
 from dlbb_tpu.models.sharding import batch_spec
@@ -48,19 +48,17 @@ from dlbb_tpu.utils.timing import (
 
 
 def build_e2e_mesh(world_size: int, data_parallel: int = 1,
-                   sequence_parallel: int = 1,
+                   sequence_parallel: int = 1, pipeline_parallel: int = 1,
                    devices: Optional[Sequence] = None):
-    """Mesh for the E2E benchmark: ``(dp, sp, tp)`` with tp = the reference's
-    ``world_size`` (``config/baseline_config.yaml:17``); the sp axis (absent
-    from the reference, SURVEY §5.7) carries ring/Ulysses context
-    parallelism."""
-    if sequence_parallel > 1:
-        spec = MeshSpec.grid(
-            (data_parallel, sequence_parallel, world_size), ("dp", "sp", "tp")
-        )
-    else:
-        spec = MeshSpec.grid((data_parallel, world_size), ("dp", "tp"))
-    return build_mesh(spec, devices=devices)
+    """Mesh for the E2E benchmark, with tp = the reference's ``world_size``
+    (``config/baseline_config.yaml:17``); the sp axis (absent from the
+    reference, SURVEY §5.7) carries ring/Ulysses context parallelism and
+    the pp axis the microbatched pipeline
+    (``dlbb_tpu/parallel/pipeline.py``)."""
+    return build_parallelism_mesh(
+        data_parallel, sequence_parallel, pipeline_parallel, world_size,
+        devices=devices,
+    )
 
 
 def run_e2e(
@@ -77,18 +75,29 @@ def run_e2e(
     world_size = par.get("world_size", 1)
     data_parallel = par.get("data_parallel", 1)
     seq_parallel = par.get("sequence_parallel", 1)
-    needed = world_size * data_parallel * seq_parallel
+    pipe_parallel = par.get("pipeline_parallel", 1)
+    num_microbatches = par.get("num_microbatches")
+    needed = world_size * data_parallel * seq_parallel * pipe_parallel
     n_avail = len(devices) if devices is not None else len(jax.devices())
     if needed > n_avail:
         # world-size preflight, parity with run_mpi.py:73-77
         raise ValueError(
             f"config needs {needed} devices (tp={world_size} x "
-            f"dp={data_parallel} x sp={seq_parallel}), only {n_avail} available"
+            f"dp={data_parallel} x sp={seq_parallel} x pp={pipe_parallel}), "
+            f"only {n_avail} available"
         )
 
-    mesh = build_e2e_mesh(world_size, data_parallel, seq_parallel, devices)
+    mesh = build_e2e_mesh(world_size, data_parallel, seq_parallel,
+                          pipe_parallel, devices)
     model_cfg = ModelConfig.from_dict(config["model"])
     validate_attention_parallelism(model_cfg, seq_parallel)
+    if pipe_parallel > 1:
+        from dlbb_tpu.parallel.pipeline import validate_pipeline
+
+        num_microbatches = validate_pipeline(
+            model_cfg, pipe_parallel, config["input"]["batch_size"],
+            num_microbatches,
+        )
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
     params = init_params_sharded(
@@ -110,7 +119,8 @@ def run_e2e(
 
     out_sharding = NamedSharding(mesh, batch_spec(mesh))
     step = jax.jit(
-        lambda p, x: forward(p, x, model_cfg, mesh=mesh),
+        lambda p, x: forward(p, x, model_cfg, mesh=mesh,
+                             num_microbatches=num_microbatches),
         out_shardings=out_sharding,
     )
 
@@ -166,7 +176,8 @@ def run_e2e(
             "attention": model_cfg.attention,
             "dtype": model_cfg.dtype,
         },
-        "mesh": {"dp": data_parallel, "sp": seq_parallel, "tp": world_size},
+        "mesh": {"dp": data_parallel, "sp": seq_parallel,
+                 "pp": pipe_parallel, "tp": world_size},
         "init_time_s": init_time,
         "compile_time_s": compile_time,
         "forward_time": summarize(forward_times),

@@ -10,6 +10,7 @@ from dlbb_tpu.comm.mesh import (
     DEFAULT_AXIS,
     MeshSpec,
     build_mesh,
+    build_parallelism_mesh,
     flat_axes,
     initialize_distributed,
     mesh_num_ranks,
@@ -26,6 +27,7 @@ __all__ = [
     "DEFAULT_AXIS",
     "MeshSpec",
     "build_mesh",
+    "build_parallelism_mesh",
     "flat_axes",
     "initialize_distributed",
     "mesh_num_ranks",

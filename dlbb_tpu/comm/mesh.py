@@ -102,6 +102,30 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(grid, spec.axis_names)
 
 
+def build_parallelism_mesh(
+    data_parallel: int = 1,
+    sequence_parallel: int = 1,
+    pipeline_parallel: int = 1,
+    tensor_parallel: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """The model-parallelism mesh shared by the E2E and train harnesses:
+    ``(dp[, sp][, pp], tp)``.  dp is always present (outermost), sp/pp only
+    when > 1, and tp always innermost — the per-layer TP allreduces are the
+    most frequent collective, so tp gets the fastest ICI neighbours."""
+    shape, names = [data_parallel], ["dp"]
+    if sequence_parallel > 1:
+        shape.append(sequence_parallel)
+        names.append("sp")
+    if pipeline_parallel > 1:
+        shape.append(pipeline_parallel)
+        names.append("pp")
+    shape.append(tensor_parallel)
+    names.append("tp")
+    return build_mesh(MeshSpec.grid(tuple(shape), tuple(names)),
+                      devices=devices)
+
+
 def mesh_num_ranks(mesh: Mesh, axes: Optional[Sequence[str]] = None) -> int:
     """Total ranks along ``axes`` (all axes if None)."""
     names = tuple(axes) if axes is not None else tuple(mesh.axis_names)

@@ -9,34 +9,55 @@ output dim and the out-proj / FFN-down kernels on their input dim over the
 all-reduces over ICI.
 
 Layer params are stacked on a leading ``num_layers`` axis (scanned in the
-forward pass), so every spec below leads with ``None`` for that axis.
+forward pass); that axis is ``None`` for pure TP and carries the ``pp``
+mesh axis under pipeline parallelism (each pipeline stage holds a
+contiguous block of layers — ``dlbb_tpu/parallel/pipeline.py``).
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 from jax.sharding import PartitionSpec as P
 
 TP_AXIS = "tp"
 DP_AXIS = "dp"
+PP_AXIS = "pp"
 
 
-def param_specs(tp_axis: str = TP_AXIS) -> dict:
-    """PartitionSpec pytree matching ``init_params``' structure."""
-    t = tp_axis
+def param_specs(tp_axis: str = TP_AXIS,
+                pp_axis: Optional[str] = None) -> dict:
+    """PartitionSpec pytree matching ``init_params``' structure.
+
+    ``pp_axis`` shards the leading stacked-layer axis across pipeline
+    stages (``None`` = no pipeline parallelism)."""
+    t, l = tp_axis, pp_axis
     return {
         "layers": {
-            "ln1": {"scale": P(None), "bias": P(None)},
+            "ln1": {"scale": P(l, None), "bias": P(l, None)},
             # column parallel: shard out_features (reference models.py:19-47)
-            "qkv": {"kernel": P(None, None, t), "bias": P(None, t)},
+            "qkv": {"kernel": P(l, None, t), "bias": P(l, t)},
             # row parallel: shard in_features; partial sums -> psum
             # (reference models.py:50-100)
-            "out": {"kernel": P(None, t, None), "bias": P(None, None)},
-            "ln2": {"scale": P(None), "bias": P(None)},
-            "ffn_up": {"kernel": P(None, None, t), "bias": P(None, t)},
-            "ffn_down": {"kernel": P(None, t, None), "bias": P(None, None)},
+            "out": {"kernel": P(l, t, None), "bias": P(l, None)},
+            "ln2": {"scale": P(l, None), "bias": P(l, None)},
+            "ffn_up": {"kernel": P(l, None, t), "bias": P(l, t)},
+            "ffn_down": {"kernel": P(l, t, None), "bias": P(l, None)},
         },
         "ln_f": {"scale": P(None), "bias": P(None)},
     }
+
+
+def specs_for_mesh(mesh, tp_axis: str = TP_AXIS,
+                   pp_axis: str = PP_AXIS) -> dict:
+    """Param specs matched to a concrete mesh: each model-parallel axis
+    (tp on features, pp on the stacked-layer dim) participates iff the
+    mesh actually has it with size > 1."""
+    axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+    use_pp = pp_axis in axes and mesh.shape[pp_axis] > 1
+    use_tp = tp_axis in axes
+    return param_specs(tp_axis if use_tp else None,
+                       pp_axis if use_pp else None)
 
 
 def batch_spec(mesh=None, dp_axis: str = DP_AXIS, sp_axis: str = "sp") -> P:

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 
 from dlbb_tpu.models.configs import ModelConfig
-from dlbb_tpu.models.sharding import param_specs
+from dlbb_tpu.models.sharding import specs_for_mesh
 
 Params = dict[str, Any]
 
@@ -175,13 +175,23 @@ def _block(x, layer: Params, config: ModelConfig, mesh=None,
 
 
 def forward(params: Params, x: jax.Array, config: ModelConfig,
-            mesh=None, sp_axis: str = "sp") -> jax.Array:
+            mesh=None, sp_axis: str = "sp",
+            num_microbatches=None) -> jax.Array:
     """Full forward pass: scan over stacked layers + final LN
     (reference ``LLM.forward`` ``models.py:224-237``).
 
     ``mesh`` is required only for sequence-parallel attention modes
-    ("ring"/"ulysses"), whose shard_map needs the concrete mesh.
+    ("ring"/"ulysses") and pipeline parallelism, whose shard_maps need the
+    concrete mesh.  A mesh with a >1-sized ``pp`` axis dispatches to the
+    microbatched pipeline engine (``dlbb_tpu/parallel/pipeline.py``).
     """
+    if (mesh is not None and "pp" in mesh.axis_names
+            and mesh.shape["pp"] > 1):
+        from dlbb_tpu.parallel.pipeline import pipeline_forward
+
+        return pipeline_forward(
+            params, x, config, mesh, num_microbatches=num_microbatches
+        )
 
     def body(carry, layer):
         return _block(carry, layer, config, mesh, sp_axis), None
@@ -206,8 +216,9 @@ def num_parameters(config: ModelConfig) -> int:
 
 
 def shard_params(params: Params, mesh: Mesh, tp_axis: str = "tp") -> Params:
-    """Place a parameter pytree onto the mesh with the Megatron TP layout."""
-    specs = param_specs(tp_axis)
+    """Place a parameter pytree onto the mesh with the Megatron TP layout
+    (plus layer-stack pp sharding when the mesh has a pp axis)."""
+    specs = specs_for_mesh(mesh, tp_axis)
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     )
@@ -226,7 +237,7 @@ def init_params_sharded(
     """
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s),
-        param_specs(tp_axis),
+        specs_for_mesh(mesh, tp_axis),
         is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
     )
     return jax.jit(

@@ -1,9 +1,10 @@
-"""Sequence/context parallelism.
+"""Sequence/context and pipeline parallelism.
 
 The reference scales sequence length only as a *payload dimension* (3D sweeps
-up to seq 8192, SURVEY §5.7) — it has no sequence-parallel attention.  A
-TPU-native long-context framework needs real context parallelism, so this
-package provides both standard schemes:
+up to seq 8192, SURVEY §5.7) — it has no sequence-parallel attention and no
+pipeline parallelism (SURVEY §2.2).  A TPU-native long-context framework
+needs real context parallelism, so this package provides both standard
+schemes plus a pipeline engine:
 
 - **ring attention** (``ring_attention``): KV blocks circulate the ICI ring
   via ``lax.ppermute`` while each device accumulates flash-style online
@@ -12,11 +13,16 @@ package provides both standard schemes:
 - **Ulysses** (``ulysses_attention``): ``lax.all_to_all`` reshards sequence
   shards into head shards, runs dense local attention per head group, and
   reshards back — 2 all-to-alls per layer, requires num_heads % sp == 0.
+- **pipeline** (``pipeline_forward``): GPipe-style microbatched pipeline
+  over a ``pp`` mesh axis — layer stack sharded across stages, activations
+  shifted with ``ppermute`` per tick, differentiable end to end.
 
-Both are exact (tested against single-device dense attention) and causal.
+Ring/Ulysses are exact (tested against single-device dense attention) and
+causal; the pipeline is exact against the single-device layer scan.
 """
 
+from dlbb_tpu.parallel.pipeline import pipeline_forward
 from dlbb_tpu.parallel.ring_attention import ring_attention
 from dlbb_tpu.parallel.ulysses import ulysses_attention
 
-__all__ = ["ring_attention", "ulysses_attention"]
+__all__ = ["pipeline_forward", "ring_attention", "ulysses_attention"]

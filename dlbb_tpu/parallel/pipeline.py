@@ -1,0 +1,141 @@
+"""Pipeline parallelism: GPipe-style microbatched pipeline over a ``pp``
+mesh axis.
+
+The reference has no pipeline parallelism (SURVEY §2.2: "PP — NO"); this is
+a capability extension, designed TPU-first rather than as a port of any
+torch pipeline engine:
+
+- the transformer's stacked-layer parameter axis is *sharded* over ``pp`` —
+  each stage owns a contiguous block of ``num_layers / pp`` layers
+  (``models/sharding.py::param_specs(pp_axis=...)``);
+- inside one ``shard_map`` (manual over ``pp`` only — ``dp``/``tp`` stay
+  under GSPMD via ``axis_names={pp}``), microbatches flow through the
+  stages with a ``lax.ppermute`` ring shift per tick: the classic
+  scan-over-ticks pipeline, one traced stage body regardless of depth;
+- tick ``t`` injects microbatch ``t`` at stage 0 and collects finished
+  microbatch ``t - (pp-1)`` at the last stage; after ``M + pp - 1`` ticks a
+  ``lax.psum`` masked to the last stage broadcasts the outputs;
+- bubble fraction is the GPipe ``(pp-1)/(M + pp - 1)``; raise
+  ``num_microbatches`` to amortise it.
+
+Forward and reverse differentiable (``ppermute``/``scan`` have exact
+transpose rules), so the same code path serves the E2E forward benchmark
+and the DDP/ZeRO training step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dlbb_tpu.models.configs import ModelConfig
+
+PP_AXIS = "pp"
+
+
+def validate_pipeline(config: ModelConfig, n_stages: int, batch_size: int,
+                      num_microbatches: Optional[int]) -> int:
+    """Check divisibility and attention-mode constraints; returns the
+    resolved microbatch count (default: one per stage)."""
+    m = num_microbatches if num_microbatches is not None else n_stages
+    if m < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got {m}")
+    if config.num_layers % n_stages != 0:
+        raise ValueError(
+            f"num_layers={config.num_layers} not divisible by "
+            f"pipeline_parallel={n_stages}"
+        )
+    if batch_size % m != 0:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by "
+            f"num_microbatches={m}"
+        )
+    if config.attention not in ("full", "simplified"):
+        raise ValueError(
+            f"attention={config.attention!r} cannot run under pipeline "
+            "parallelism (ring/ulysses/flash need their own shard_map; "
+            "use attention='full' or 'simplified' with pipeline_parallel > 1)"
+        )
+    return m
+
+
+def pipeline_forward(
+    params,
+    x: jax.Array,
+    config: ModelConfig,
+    mesh: Mesh,
+    pp_axis: str = PP_AXIS,
+    num_microbatches: Optional[int] = None,
+) -> jax.Array:
+    """Full-model forward with the layer stack pipelined over ``pp_axis``.
+
+    ``params`` must hold the stacked-layer pytree of
+    ``models/transformer.py::init_params`` with the leading layer axis
+    sharded over ``pp_axis``; the final layernorm runs outside the
+    pipeline (replicated, applied after the shard_map).
+    """
+    from dlbb_tpu.models.transformer import _block, _layernorm
+
+    n_stages = mesh.shape[pp_axis]
+    m = validate_pipeline(config, n_stages, x.shape[0], num_microbatches)
+
+    layer_specs = jax.tree.map(lambda _: P(pp_axis), params["layers"])
+
+    def stage_local(layers_local, x):
+        # layers_local: this stage's [L/pp, ...] block; x: full [B, S, H]
+        pp = lax.axis_index(pp_axis)
+        mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
+        state = lax.pcast(jnp.zeros_like(mb[0]), (pp_axis,), to="varying")
+        outputs = lax.pcast(jnp.zeros_like(mb), (pp_axis,), to="varying")
+
+        def local_fwd(h):
+            def body(carry, layer):
+                return _block(carry, layer, config), None
+
+            h, _ = lax.scan(body, h, layers_local)
+            return h
+
+        def tick(carry, t):
+            state, outputs = carry
+            inject = lax.dynamic_index_in_dim(
+                mb, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            y = local_fwd(jnp.where(pp == 0, inject, state))
+            out_t = t - (n_stages - 1)
+            write = jnp.logical_and(
+                pp == n_stages - 1,
+                jnp.logical_and(out_t >= 0, out_t < m),
+            )
+            updated = lax.dynamic_update_index_in_dim(
+                outputs, y, jnp.clip(out_t, 0, m - 1), 0
+            )
+            outputs = jnp.where(write, updated, outputs)
+            state = lax.ppermute(
+                y, pp_axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)],
+            )
+            return (state, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(m + n_stages - 1)
+        )
+        # only the last stage holds real outputs; the masked psum is the
+        # SPMD broadcast back to every stage
+        outputs = lax.psum(
+            jnp.where(pp == n_stages - 1, outputs, jnp.zeros_like(outputs)),
+            pp_axis,
+        )
+        return outputs.reshape(x.shape)
+
+    y = shard_map(
+        stage_local,
+        mesh=mesh,
+        in_specs=(layer_specs, P()),
+        out_specs=P(),
+        axis_names={pp_axis},
+    )(params["layers"], x)
+    return _layernorm(y, params["ln_f"]["scale"], params["ln_f"]["bias"])

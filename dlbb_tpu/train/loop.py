@@ -40,10 +40,10 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+from dlbb_tpu.comm.mesh import build_parallelism_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
 from dlbb_tpu.models.configs import ModelConfig, validate_attention_parallelism
-from dlbb_tpu.models.sharding import batch_spec, param_specs
+from dlbb_tpu.models.sharding import batch_spec, param_specs, specs_for_mesh
 from dlbb_tpu.models.transformer import forward, init_params_sharded
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import summarize
@@ -84,18 +84,21 @@ def _dp_shard_spec(spec: P, shape: tuple[int, ...], dp_size: int,
 
 
 def dp_sharded_param_specs(params: Any, dp_size: int,
-                           dp_axis: str = "dp") -> Any:
-    """The TP spec tree with a ``dp`` sharding added per leaf — the FSDP /
-    ZeRO-3 parameter layout, also the ZeRO-{1,2} optimizer-state/grad
-    layout."""
+                           dp_axis: str = "dp",
+                           base_specs: Any = None) -> Any:
+    """The TP (or TP+PP) spec tree with a ``dp`` sharding added per leaf —
+    the FSDP / ZeRO-3 parameter layout, also the ZeRO-{1,2}
+    optimizer-state/grad layout."""
+    if base_specs is None:
+        base_specs = param_specs()
     return jax.tree.map(
         lambda s, p: _dp_shard_spec(s, p.shape, dp_size, dp_axis),
-        param_specs(), params, is_leaf=_is_spec,
+        base_specs, params, is_leaf=_is_spec,
     )
 
 
 def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
-                    dp_size: int) -> Any:
+                    dp_size: int, base_specs: Any = None) -> Any:
     """Partition specs for the optimizer-state pytree.
 
     Optax state subtrees that mirror the param pytree (Adam mu/nu) are
@@ -105,8 +108,11 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
     Everything else (step counts, empty states) stays replicated.
     """
     p_def = jax.tree.structure(params)
+    if base_specs is None:
+        base_specs = param_specs()
     spec_for_params = (
-        dp_sharded_param_specs(params, dp_size) if zero1 else param_specs()
+        dp_sharded_param_specs(params, dp_size, base_specs=base_specs)
+        if zero1 else base_specs
     )
 
     def recur(node):
@@ -130,8 +136,10 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
 
 
 def mse_loss(params, batch, targets, config: ModelConfig,
-             mesh: Optional[Mesh] = None) -> jax.Array:
-    pred = forward(params, batch, config, mesh=mesh)
+             mesh: Optional[Mesh] = None,
+             num_microbatches: Optional[int] = None) -> jax.Array:
+    pred = forward(params, batch, config, mesh=mesh,
+                   num_microbatches=num_microbatches)
     return jnp.mean(
         (pred.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2
     )
@@ -158,19 +166,24 @@ def make_train_step(
     params: Any,
     zero1: bool = False,
     zero_stage: Optional[int] = None,
+    num_microbatches: Optional[int] = None,
 ):
     """Build (jitted step fn, initial sharded TrainState) for the given
-    ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP)."""
+    ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP).
+    A mesh with a >1-sized ``pp`` axis makes the inner forward pipelined
+    (``num_microbatches`` microbatches, default one per stage)."""
     stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
-    dp_specs = dp_sharded_param_specs(params, dp_size)
-    p_spec_tree = dp_specs if stage >= 3 else param_specs()
+    base_specs = specs_for_mesh(mesh)
+    dp_specs = dp_sharded_param_specs(params, dp_size, base_specs=base_specs)
+    p_spec_tree = dp_specs if stage >= 3 else base_specs
     p_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), p_spec_tree, is_leaf=_is_spec
     )
     params = jax.device_put(params, p_shardings)
     opt_state = optimizer.init(params)
-    s_specs = opt_state_specs(params, opt_state, stage >= 1, dp_size)
+    s_specs = opt_state_specs(params, opt_state, stage >= 1, dp_size,
+                              base_specs=base_specs)
     s_shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s), s_specs, is_leaf=_is_spec
     )
@@ -186,7 +199,7 @@ def make_train_step(
 
     def step(state: TrainState, batch, targets):
         loss, grads = jax.value_and_grad(mse_loss)(
-            state.params, batch, targets, config, mesh
+            state.params, batch, targets, config, mesh, num_microbatches
         )
         if stage >= 2:
             # pin grads to the dp-sharded layout: the dp all-reduce lowers
@@ -225,20 +238,24 @@ def run_train(
     tp = par.get("world_size", 1)
     dp = par.get("data_parallel", 1)
     sp = par.get("sequence_parallel", 1)
+    pp = par.get("pipeline_parallel", 1)
+    num_microbatches = par.get("num_microbatches")
     n_avail = len(devices) if devices is not None else len(jax.devices())
-    if tp * dp * sp > n_avail:
+    if tp * dp * sp * pp > n_avail:
         raise ValueError(
-            f"config needs {tp * dp * sp} devices (tp={tp} x dp={dp} x "
-            f"sp={sp}), only {n_avail} available"
+            f"config needs {tp * dp * sp * pp} devices (tp={tp} x dp={dp} x "
+            f"sp={sp} x pp={pp}), only {n_avail} available"
         )
-    if sp > 1:
-        spec = MeshSpec.grid((dp, sp, tp), ("dp", "sp", "tp"))
-    else:
-        spec = MeshSpec.grid((dp, tp), ("dp", "tp"))
-    mesh = build_mesh(spec, devices=devices)
+    mesh = build_parallelism_mesh(dp, sp, pp, tp, devices=devices)
 
     model_cfg = ModelConfig.from_dict(config["model"])
     validate_attention_parallelism(model_cfg, sp)
+    if pp > 1:
+        from dlbb_tpu.parallel.pipeline import validate_pipeline
+
+        num_microbatches = validate_pipeline(
+            model_cfg, pp, config["input"]["batch_size"], num_microbatches
+        )
     inp = config["input"]
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
     data = SyntheticEmbeddingDataset(
@@ -258,7 +275,8 @@ def run_train(
         model_cfg, jax.random.key(inp.get("seed", 42)), mesh
     )
     jit_step, state = make_train_step(
-        model_cfg, mesh, optimizer, params, zero_stage=stage
+        model_cfg, mesh, optimizer, params, zero_stage=stage,
+        num_microbatches=num_microbatches,
     )
 
     # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see
@@ -334,7 +352,7 @@ def run_train(
         "mode": MODE_NAMES[stage],
         "zero_stage": stage,
         "resumed_from_step": resumed_from,
-        "mesh": {"dp": dp, "sp": sp, "tp": tp},
+        "mesh": {"dp": dp, "sp": sp, "pp": pp, "tp": tp},
         "learning_rate": lr,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),

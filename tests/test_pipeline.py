@@ -1,0 +1,105 @@
+"""Pipeline-parallelism tests: exactness of the GPipe engine vs the plain
+layer scan, composition with dp/tp, and the training path (capability
+extension — the reference has no PP, SURVEY §2.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.models.transformer import forward, init_params, shard_params
+from dlbb_tpu.parallel.pipeline import validate_pipeline
+from dlbb_tpu.train.loop import run_train
+
+TINY = ModelConfig(hidden_size=32, num_layers=4, num_heads=4,
+                   ffn_intermediate=64, attention="full", dtype="float32")
+
+
+def _x(batch=8, seq=16, hidden=32, seed=1):
+    return jax.random.normal(jax.random.key(seed), (batch, seq, hidden),
+                             dtype=jnp.float32)
+
+
+def test_pipeline_matches_single_device(devices):
+    """pp=4 pipeline output must equal the unsharded layer scan exactly."""
+    params = init_params(TINY, jax.random.key(0))
+    x = _x()
+    y_ref = jax.jit(lambda p, x: forward(p, x, TINY))(params, x)
+
+    mesh = build_mesh(MeshSpec.grid((4,), ("pp",)))
+    params_pp = shard_params(params, mesh)
+    y_pp = jax.jit(
+        lambda p, x: forward(p, x, TINY, mesh=mesh)
+    )(params_pp, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_pp),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_with_dp_tp(devices):
+    """pp composes with dp and tp on a (dp=2, pp=2, tp=2) mesh."""
+    params = init_params(TINY, jax.random.key(0))
+    x = _x()
+    y_ref = jax.jit(lambda p, x: forward(p, x, TINY))(params, x)
+
+    mesh = build_mesh(MeshSpec.grid((2, 2, 2), ("dp", "pp", "tp")))
+    params_s = shard_params(params, mesh)
+    y = jax.jit(lambda p, x: forward(p, x, TINY, mesh=mesh))(params_s, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_microbatch_count(devices):
+    """More microbatches than stages (bubble amortisation) stays exact."""
+    params = init_params(TINY, jax.random.key(0))
+    x = _x()
+    y_ref = jax.jit(lambda p, x: forward(p, x, TINY))(params, x)
+
+    mesh = build_mesh(MeshSpec.grid((2,), ("pp",)))
+    params_pp = shard_params(params, mesh)
+    y = jax.jit(
+        lambda p, x: forward(p, x, TINY, mesh=mesh, num_microbatches=8)
+    )(params_pp, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
+def _train_config(pp=1):
+    cfg = {
+        "experiment": {"name": "train_pp"},
+        "model": {
+            "hidden_size": 32, "num_layers": 4, "num_heads": 4,
+            "ffn_intermediate": 64, "attention": "full", "dtype": "float32",
+        },
+        "parallelism": {"world_size": 2, "data_parallel": 2},
+        "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 4},
+        "training": {"learning_rate": 1e-2},
+    }
+    if pp > 1:
+        cfg["parallelism"]["pipeline_parallel"] = pp
+    return cfg
+
+
+def test_pipeline_train_matches_plain(devices):
+    """The pipelined train step must follow the same optimisation
+    trajectory as the unpipelined one (same global math)."""
+    r_plain = run_train(_train_config(pp=1), verbose=False)
+    r_pp = run_train(_train_config(pp=2), verbose=False)
+    assert r_pp["mesh"]["pp"] == 2
+    np.testing.assert_allclose(
+        r_plain["losses"], r_pp["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_validate_pipeline_errors():
+    with pytest.raises(ValueError, match="not divisible by"):
+        validate_pipeline(TINY, 3, 8, None)  # 4 layers % 3 stages
+    with pytest.raises(ValueError, match="num_microbatches"):
+        validate_pipeline(TINY, 2, 8, 3)  # batch 8 % 3 microbatches
+    ring = TINY.with_(attention="ring")
+    with pytest.raises(ValueError, match="pipeline"):
+        validate_pipeline(ring, 2, 8, None)
+    assert validate_pipeline(TINY, 2, 8, None) == 2
+    assert validate_pipeline(TINY, 2, 8, 4) == 4
