@@ -28,7 +28,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dlbb_tpu.comm.mesh import build_parallelism_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
-from dlbb_tpu.models.configs import ModelConfig, validate_attention_parallelism
+from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.parallel.plan import ParallelismPlan
 from dlbb_tpu.models.sharding import batch_spec
 from dlbb_tpu.models.transformer import (
     forward,
@@ -49,15 +50,16 @@ from dlbb_tpu.utils.timing import (
 
 def build_e2e_mesh(world_size: int, data_parallel: int = 1,
                    sequence_parallel: int = 1, pipeline_parallel: int = 1,
+                   expert_parallel: int = 1,
                    devices: Optional[Sequence] = None):
     """Mesh for the E2E benchmark, with tp = the reference's ``world_size``
     (``config/baseline_config.yaml:17``); the sp axis (absent from the
-    reference, SURVEY §5.7) carries ring/Ulysses context parallelism and
-    the pp axis the microbatched pipeline
-    (``dlbb_tpu/parallel/pipeline.py``)."""
+    reference, SURVEY §5.7) carries ring/Ulysses context parallelism, the
+    pp axis the microbatched pipeline (``dlbb_tpu/parallel/pipeline.py``),
+    and the ep axis MoE expert sharding."""
     return build_parallelism_mesh(
         data_parallel, sequence_parallel, pipeline_parallel, world_size,
-        devices=devices,
+        expert_parallel, devices=devices,
     )
 
 
@@ -71,33 +73,9 @@ def run_e2e(
     ``configs/baseline_config.yaml``; parity with ``run_mpi.py:main``)."""
     t_init = time.perf_counter()
 
-    par = config.get("parallelism", {})
-    world_size = par.get("world_size", 1)
-    data_parallel = par.get("data_parallel", 1)
-    seq_parallel = par.get("sequence_parallel", 1)
-    pipe_parallel = par.get("pipeline_parallel", 1)
-    num_microbatches = par.get("num_microbatches")
-    needed = world_size * data_parallel * seq_parallel * pipe_parallel
-    n_avail = len(devices) if devices is not None else len(jax.devices())
-    if needed > n_avail:
-        # world-size preflight, parity with run_mpi.py:73-77
-        raise ValueError(
-            f"config needs {needed} devices (tp={world_size} x "
-            f"dp={data_parallel} x sp={seq_parallel} x pp={pipe_parallel}), "
-            f"only {n_avail} available"
-        )
-
-    mesh = build_e2e_mesh(world_size, data_parallel, seq_parallel,
-                          pipe_parallel, devices)
     model_cfg = ModelConfig.from_dict(config["model"])
-    validate_attention_parallelism(model_cfg, seq_parallel)
-    if pipe_parallel > 1:
-        from dlbb_tpu.parallel.pipeline import validate_pipeline
-
-        num_microbatches = validate_pipeline(
-            model_cfg, pipe_parallel, config["input"]["batch_size"],
-            num_microbatches,
-        )
+    plan = ParallelismPlan.from_config(config, model_cfg, devices)
+    mesh, num_microbatches = plan.mesh, plan.num_microbatches
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
     params = init_params_sharded(
@@ -176,8 +154,7 @@ def run_e2e(
             "attention": model_cfg.attention,
             "dtype": model_cfg.dtype,
         },
-        "mesh": {"dp": data_parallel, "sp": seq_parallel,
-                 "pp": pipe_parallel, "tp": world_size},
+        "mesh": plan.mesh_dict(),
         "init_time_s": init_time,
         "compile_time_s": compile_time,
         "forward_time": summarize(forward_times),

@@ -107,12 +107,14 @@ def build_parallelism_mesh(
     sequence_parallel: int = 1,
     pipeline_parallel: int = 1,
     tensor_parallel: int = 1,
+    expert_parallel: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """The model-parallelism mesh shared by the E2E and train harnesses:
-    ``(dp[, sp][, pp], tp)``.  dp is always present (outermost), sp/pp only
-    when > 1, and tp always innermost — the per-layer TP allreduces are the
-    most frequent collective, so tp gets the fastest ICI neighbours."""
+    ``(dp[, sp][, pp][, ep], tp)``.  dp is always present (outermost),
+    sp/pp/ep only when > 1, and tp always innermost — the per-layer TP
+    allreduces are the most frequent collective, so tp gets the fastest
+    ICI neighbours."""
     shape, names = [data_parallel], ["dp"]
     if sequence_parallel > 1:
         shape.append(sequence_parallel)
@@ -120,6 +122,9 @@ def build_parallelism_mesh(
     if pipeline_parallel > 1:
         shape.append(pipeline_parallel)
         names.append("pp")
+    if expert_parallel > 1:
+        shape.append(expert_parallel)
+        names.append("ep")
     shape.append(tensor_parallel)
     names.append("tp")
     return build_mesh(MeshSpec.grid(tuple(shape), tuple(names)),

@@ -23,6 +23,12 @@ class ModelConfig:
     # attention — dlbb_tpu.parallel)
     attention: str = "full"
     dtype: str = "bfloat16"
+    # Mixture-of-experts FFN (0 = dense FFN).  num_experts > 0 replaces each
+    # block's FFN with moe_top_k-gated experts; experts shard over an
+    # ``ep`` mesh axis (capability extension — the reference has no EP,
+    # SURVEY §2.2).
+    num_experts: int = 0
+    moe_top_k: int = 2
 
     def __post_init__(self) -> None:
         if self.hidden_size % self.num_heads != 0:
@@ -33,10 +39,22 @@ class ModelConfig:
         if self.attention not in ("full", "simplified", "flash", "ring",
                                   "ulysses"):
             raise ValueError(f"unknown attention mode {self.attention!r}")
+        if self.num_experts < 0:
+            raise ValueError(f"num_experts must be >= 0, got {self.num_experts}")
+        if self.num_experts > 0 and not (
+                1 <= self.moe_top_k <= self.num_experts):
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} must be in [1, "
+                f"num_experts={self.num_experts}]"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
 
     def with_(self, **kw) -> "ModelConfig":
         return replace(self, **kw)
@@ -53,7 +71,7 @@ class ModelConfig:
         fields = {}
         for k in (
             "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
-            "attention", "dtype",
+            "attention", "dtype", "num_experts", "moe_top_k",
         ):
             if k in d:
                 fields[k] = d[k]
@@ -82,6 +100,22 @@ def validate_attention_parallelism(config: ModelConfig, sp: int) -> None:
             f"{SP_CAPABLE_ATTENTION} (attention={config.attention!r} does "
             "not partition the sequence; it would run replicated per sp "
             "shard)"
+        )
+
+
+def validate_expert_parallelism(config: ModelConfig, ep: int) -> None:
+    """Reject expert-parallel degrees that cannot shard the expert dim."""
+    if ep <= 1:
+        return
+    if not config.is_moe:
+        raise ValueError(
+            f"parallelism.expert_parallel={ep} requires a MoE model "
+            "(model.num_experts > 0)"
+        )
+    if config.num_experts % ep != 0:
+        raise ValueError(
+            f"num_experts={config.num_experts} not divisible by "
+            f"expert_parallel={ep}"
         )
 
 

@@ -23,15 +23,35 @@ from jax.sharding import PartitionSpec as P
 TP_AXIS = "tp"
 DP_AXIS = "dp"
 PP_AXIS = "pp"
+EP_AXIS = "ep"
 
 
-def param_specs(tp_axis: str = TP_AXIS,
-                pp_axis: Optional[str] = None) -> dict:
+def param_specs(tp_axis: Optional[str] = TP_AXIS,
+                pp_axis: Optional[str] = None,
+                moe: bool = False,
+                ep_axis: Optional[str] = None) -> dict:
     """PartitionSpec pytree matching ``init_params``' structure.
 
     ``pp_axis`` shards the leading stacked-layer axis across pipeline
-    stages (``None`` = no pipeline parallelism)."""
-    t, l = tp_axis, pp_axis
+    stages; ``moe`` switches the FFN specs to the expert-stacked MoE
+    layout, whose expert dim shards over ``ep_axis`` (``None`` = no such
+    parallelism)."""
+    t, l, e = tp_axis, pp_axis, ep_axis
+    if moe:
+        ffn = {
+            # router stays replicated over tp/ep: [L, H, E] is tiny and
+            # every device needs the full gate distribution
+            "router": {"kernel": P(l, None, None)},
+            # experts shard over ep on their leading expert dim, and each
+            # expert keeps the Megatron col/row TP split on its features
+            "ffn_up": {"kernel": P(l, e, None, t), "bias": P(l, e, t)},
+            "ffn_down": {"kernel": P(l, e, t, None), "bias": P(l, e, None)},
+        }
+    else:
+        ffn = {
+            "ffn_up": {"kernel": P(l, None, t), "bias": P(l, t)},
+            "ffn_down": {"kernel": P(l, t, None), "bias": P(l, None)},
+        }
     return {
         "layers": {
             "ln1": {"scale": P(l, None), "bias": P(l, None)},
@@ -41,23 +61,26 @@ def param_specs(tp_axis: str = TP_AXIS,
             # (reference models.py:50-100)
             "out": {"kernel": P(l, t, None), "bias": P(l, None)},
             "ln2": {"scale": P(l, None), "bias": P(l, None)},
-            "ffn_up": {"kernel": P(l, None, t), "bias": P(l, t)},
-            "ffn_down": {"kernel": P(l, t, None), "bias": P(l, None)},
+            **ffn,
         },
         "ln_f": {"scale": P(None), "bias": P(None)},
     }
 
 
 def specs_for_mesh(mesh, tp_axis: str = TP_AXIS,
-                   pp_axis: str = PP_AXIS) -> dict:
+                   pp_axis: str = PP_AXIS, moe: bool = False,
+                   ep_axis: str = EP_AXIS) -> dict:
     """Param specs matched to a concrete mesh: each model-parallel axis
-    (tp on features, pp on the stacked-layer dim) participates iff the
-    mesh actually has it with size > 1."""
+    (tp on features, pp on the stacked-layer dim, ep on the expert dim)
+    participates iff the mesh actually has it with size > 1."""
     axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
     use_pp = pp_axis in axes and mesh.shape[pp_axis] > 1
     use_tp = tp_axis in axes
+    use_ep = moe and ep_axis in axes and mesh.shape[ep_axis] > 1
     return param_specs(tp_axis if use_tp else None,
-                       pp_axis if use_pp else None)
+                       pp_axis if use_pp else None,
+                       moe=moe,
+                       ep_axis=ep_axis if use_ep else None)
 
 
 def batch_spec(mesh=None, dp_axis: str = DP_AXIS, sp_axis: str = "sp") -> P:

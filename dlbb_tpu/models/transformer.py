@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding
 
 from dlbb_tpu.models.configs import ModelConfig
-from dlbb_tpu.models.sharding import specs_for_mesh
+from dlbb_tpu.models.sharding import PP_AXIS, specs_for_mesh
 
 Params = dict[str, Any]
 
@@ -53,7 +53,32 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
         # init_params_sharded, which materialises shards in place)
         return jax.random.normal(key, shape, dtype=dtype) / math.sqrt(fan_in)
 
-    ks = jax.random.split(key, 4)
+    ks = jax.random.split(key, 5)
+    if config.is_moe:
+        E = config.num_experts
+        ffn = {
+            # router logits in the params dtype; gating math runs in fp32
+            "router": {"kernel": kernel(ks[4], (L, h, E), h)},
+            "ffn_up": {
+                "kernel": kernel(ks[2], (L, E, h, f), h),
+                "bias": jnp.zeros((L, E, f), dtype),
+            },
+            "ffn_down": {
+                "kernel": kernel(ks[3], (L, E, f, h), f),
+                "bias": jnp.zeros((L, E, h), dtype),
+            },
+        }
+    else:
+        ffn = {
+            "ffn_up": {
+                "kernel": kernel(ks[2], (L, h, f), h),
+                "bias": jnp.zeros((L, f), dtype),
+            },
+            "ffn_down": {
+                "kernel": kernel(ks[3], (L, f, h), f),
+                "bias": jnp.zeros((L, h), dtype),
+            },
+        }
     layers = {
         "ln1": {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)},
         "qkv": {
@@ -65,14 +90,7 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
             "bias": jnp.zeros((L, h), dtype),
         },
         "ln2": {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)},
-        "ffn_up": {
-            "kernel": kernel(ks[2], (L, h, f), h),
-            "bias": jnp.zeros((L, f), dtype),
-        },
-        "ffn_down": {
-            "kernel": kernel(ks[3], (L, f, h), f),
-            "bias": jnp.zeros((L, h), dtype),
-        },
+        **ffn,
     }
     return {
         "layers": layers,
@@ -156,10 +174,43 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
     return o.transpose(0, 2, 1, 3).reshape(b, s, n * d)
 
 
+def top_k_gates(logits: jax.Array, k: int) -> jax.Array:
+    """Sparse top-k routing weights from router logits [..., E]: full fp32
+    softmax, keep the k largest probabilities, renormalise to sum 1
+    (Mixtral-style gating).  Returns [..., E] with exactly k nonzeros."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, k)
+    mask = jax.nn.one_hot(top_idx, logits.shape[-1],
+                          dtype=probs.dtype).sum(axis=-2)
+    gated = probs * mask
+    return gated / gated.sum(axis=-1, keepdims=True)
+
+
+def _moe_ffn(y, layer: Params, config: ModelConfig):
+    """Top-k gated mixture-of-experts FFN: [B, S, H] -> [B, S, H].
+
+    Dense-dispatch design: every expert runs on every token and the gate
+    weights (zero outside the top-k) select the combination.  Static
+    shapes, no token dropping, exact under any sharding; with the expert
+    dim sharded over ``ep`` each device computes only its local experts
+    and the final gate contraction becomes the psum over ``ep`` (GSPMD).
+    """
+    logits = y @ layer["router"]["kernel"]                  # [B, S, E]
+    gates = top_k_gates(logits, config.moe_top_k).astype(y.dtype)
+    up = jnp.einsum("bsh,ehf->bsef", y, layer["ffn_up"]["kernel"])
+    up = up + layer["ffn_up"]["bias"][None, None, :, :]
+    act = jax.nn.gelu(up)
+    per_expert = jnp.einsum("bsef,efh->bseh", act,
+                            layer["ffn_down"]["kernel"])
+    per_expert = per_expert + layer["ffn_down"]["bias"][None, None, :, :]
+    return jnp.einsum("bseh,bse->bsh", per_expert, gates)
+
+
 def _block(x, layer: Params, config: ModelConfig, mesh=None,
            sp_axis: str = "sp"):
     """One transformer block (reference ``TransformerBlock.forward``
-    ``models.py:147-190``)."""
+    ``models.py:147-190``); the FFN is the gated-expert mixture when
+    ``config.num_experts > 0``."""
     residual = x
     y = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
     qkv = y @ layer["qkv"]["kernel"] + layer["qkv"]["bias"]
@@ -168,29 +219,33 @@ def _block(x, layer: Params, config: ModelConfig, mesh=None,
 
     residual = x
     y = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
-    y = y @ layer["ffn_up"]["kernel"] + layer["ffn_up"]["bias"]
-    y = jax.nn.gelu(y)
-    x = y @ layer["ffn_down"]["kernel"] + layer["ffn_down"]["bias"] + residual
+    if config.is_moe:
+        x = _moe_ffn(y, layer, config) + residual
+    else:
+        y = y @ layer["ffn_up"]["kernel"] + layer["ffn_up"]["bias"]
+        y = jax.nn.gelu(y)
+        x = y @ layer["ffn_down"]["kernel"] + layer["ffn_down"]["bias"] + residual
     return x
 
 
 def forward(params: Params, x: jax.Array, config: ModelConfig,
-            mesh=None, sp_axis: str = "sp",
+            mesh=None, sp_axis: str = "sp", pp_axis: str = PP_AXIS,
             num_microbatches=None) -> jax.Array:
     """Full forward pass: scan over stacked layers + final LN
     (reference ``LLM.forward`` ``models.py:224-237``).
 
     ``mesh`` is required only for sequence-parallel attention modes
     ("ring"/"ulysses") and pipeline parallelism, whose shard_maps need the
-    concrete mesh.  A mesh with a >1-sized ``pp`` axis dispatches to the
+    concrete mesh.  A mesh with a >1-sized ``pp_axis`` dispatches to the
     microbatched pipeline engine (``dlbb_tpu/parallel/pipeline.py``).
     """
-    if (mesh is not None and "pp" in mesh.axis_names
-            and mesh.shape["pp"] > 1):
+    if (mesh is not None and pp_axis in mesh.axis_names
+            and mesh.shape[pp_axis] > 1):
         from dlbb_tpu.parallel.pipeline import pipeline_forward
 
         return pipeline_forward(
-            params, x, config, mesh, num_microbatches=num_microbatches
+            params, x, config, mesh, pp_axis=pp_axis,
+            num_microbatches=num_microbatches,
         )
 
     def body(carry, layer):
@@ -202,23 +257,28 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
 
 def num_parameters(config: ModelConfig) -> int:
     """Total parameter count (reference ``get_num_parameters``
-    ``models.py:239-241``)."""
+    ``models.py:239-241``; MoE counts every expert + router)."""
     h, f, L = config.hidden_size, config.ffn_intermediate, config.num_layers
+    if config.is_moe:
+        E = config.num_experts
+        ffn = h * E + E * (h * f + f) + E * (f * h + h)  # router + experts
+    else:
+        ffn = (h * f + f) + (f * h + h)
     per_layer = (
         2 * h            # ln1
         + h * 3 * h + 3 * h  # qkv
         + h * h + h      # out
         + 2 * h          # ln2
-        + h * f + f      # ffn_up
-        + f * h + h      # ffn_down
+        + ffn
     )
     return L * per_layer + 2 * h  # + final LN
 
 
 def shard_params(params: Params, mesh: Mesh, tp_axis: str = "tp") -> Params:
     """Place a parameter pytree onto the mesh with the Megatron TP layout
-    (plus layer-stack pp sharding when the mesh has a pp axis)."""
-    specs = specs_for_mesh(mesh, tp_axis)
+    (plus layer-stack pp / expert ep sharding when the mesh has those
+    axes; MoE is detected from the pytree structure)."""
+    specs = specs_for_mesh(mesh, tp_axis, moe="router" in params["layers"])
     return jax.tree.map(
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs
     )
@@ -237,7 +297,7 @@ def init_params_sharded(
     """
     shardings = jax.tree.map(
         lambda s: NamedSharding(mesh, s),
-        specs_for_mesh(mesh, tp_axis),
+        specs_for_mesh(mesh, tp_axis, moe=config.is_moe),
         is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
     )
     return jax.jit(

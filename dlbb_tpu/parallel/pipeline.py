@@ -33,8 +33,7 @@ from jax import lax, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlbb_tpu.models.configs import ModelConfig
-
-PP_AXIS = "pp"
+from dlbb_tpu.models.sharding import PP_AXIS
 
 
 def validate_pipeline(config: ModelConfig, n_stages: int, batch_size: int,

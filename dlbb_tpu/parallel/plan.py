@@ -1,0 +1,79 @@
+"""Shared parallelism-plan resolution for the E2E and train harnesses.
+
+One place that parses the YAML ``parallelism:`` section, runs every
+validation (device preflight — parity with reference ``run_mpi.py:73-77`` —
+attention/sp, MoE/ep, pipeline divisibility), and builds the mesh; the two
+harnesses consume the resulting plan instead of duplicating the logic.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from dlbb_tpu.comm.mesh import build_parallelism_mesh
+from dlbb_tpu.models.configs import (
+    ModelConfig,
+    validate_attention_parallelism,
+    validate_expert_parallelism,
+)
+from dlbb_tpu.parallel.pipeline import validate_pipeline
+
+
+@dataclass(frozen=True)
+class ParallelismPlan:
+    dp: int
+    sp: int
+    pp: int
+    ep: int
+    tp: int
+    num_microbatches: Optional[int]
+    mesh: Mesh
+
+    @classmethod
+    def from_config(
+        cls,
+        config: dict[str, Any],
+        model_cfg: ModelConfig,
+        devices: Optional[Sequence] = None,
+    ) -> "ParallelismPlan":
+        par = config.get("parallelism", {})
+        tp = par.get("world_size", 1)
+        dp = par.get("data_parallel", 1)
+        sp = par.get("sequence_parallel", 1)
+        pp = par.get("pipeline_parallel", 1)
+        ep = par.get("expert_parallel", 1)
+        num_microbatches = par.get("num_microbatches")
+
+        needed = tp * dp * sp * pp * ep
+        n_avail = len(devices) if devices is not None else len(jax.devices())
+        if needed > n_avail:
+            raise ValueError(
+                f"config needs {needed} devices (tp={tp} x dp={dp} x "
+                f"sp={sp} x pp={pp} x ep={ep}), only {n_avail} available"
+            )
+
+        validate_attention_parallelism(model_cfg, sp)
+        validate_expert_parallelism(model_cfg, ep)
+        if pp > 1:
+            num_microbatches = validate_pipeline(
+                model_cfg, pp, config["input"]["batch_size"],
+                num_microbatches,
+            )
+        elif num_microbatches is not None:
+            raise ValueError(
+                "parallelism.num_microbatches requires "
+                "pipeline_parallel > 1 (microbatching is the pipeline's "
+                "schedule; without pp it would silently be ignored)"
+            )
+
+        mesh = build_parallelism_mesh(dp, sp, pp, tp, ep, devices=devices)
+        return cls(dp, sp, pp, ep, tp, num_microbatches, mesh)
+
+    def mesh_dict(self) -> dict[str, int]:
+        """The result-JSON ``mesh`` field."""
+        return {"dp": self.dp, "sp": self.sp, "pp": self.pp,
+                "ep": self.ep, "tp": self.tp}

@@ -40,9 +40,9 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.comm.mesh import build_parallelism_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
-from dlbb_tpu.models.configs import ModelConfig, validate_attention_parallelism
+from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.parallel.plan import ParallelismPlan
 from dlbb_tpu.models.sharding import batch_spec, param_specs, specs_for_mesh
 from dlbb_tpu.models.transformer import forward, init_params_sharded
 from dlbb_tpu.utils.config import load_config, save_json
@@ -174,7 +174,7 @@ def make_train_step(
     (``num_microbatches`` microbatches, default one per stage)."""
     stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
-    base_specs = specs_for_mesh(mesh)
+    base_specs = specs_for_mesh(mesh, moe=config.is_moe)
     dp_specs = dp_sharded_param_specs(params, dp_size, base_specs=base_specs)
     p_spec_tree = dp_specs if stage >= 3 else base_specs
     p_shardings = jax.tree.map(
@@ -229,33 +229,15 @@ def run_train(
 ) -> dict[str, Any]:
     """Config-driven training benchmark (the train-side analogue of the E2E
     forward harness; reference flow ``test/ccl.py:59-117``)."""
-    par = config.get("parallelism", {})
     # explicit caller args (zero_stage or legacy zero1) win over the config
     if zero_stage is None and not zero1 \
             and "zero_stage" in config.get("training", {}):
         zero_stage = config["training"]["zero_stage"]
     stage = resolve_zero_stage(zero1, zero_stage)
-    tp = par.get("world_size", 1)
-    dp = par.get("data_parallel", 1)
-    sp = par.get("sequence_parallel", 1)
-    pp = par.get("pipeline_parallel", 1)
-    num_microbatches = par.get("num_microbatches")
-    n_avail = len(devices) if devices is not None else len(jax.devices())
-    if tp * dp * sp * pp > n_avail:
-        raise ValueError(
-            f"config needs {tp * dp * sp * pp} devices (tp={tp} x dp={dp} x "
-            f"sp={sp} x pp={pp}), only {n_avail} available"
-        )
-    mesh = build_parallelism_mesh(dp, sp, pp, tp, devices=devices)
 
     model_cfg = ModelConfig.from_dict(config["model"])
-    validate_attention_parallelism(model_cfg, sp)
-    if pp > 1:
-        from dlbb_tpu.parallel.pipeline import validate_pipeline
-
-        num_microbatches = validate_pipeline(
-            model_cfg, pp, config["input"]["batch_size"], num_microbatches
-        )
+    plan = ParallelismPlan.from_config(config, model_cfg, devices)
+    mesh, num_microbatches = plan.mesh, plan.num_microbatches
     inp = config["input"]
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
     data = SyntheticEmbeddingDataset(
@@ -352,7 +334,7 @@ def run_train(
         "mode": MODE_NAMES[stage],
         "zero_stage": stage,
         "resumed_from_step": resumed_from,
-        "mesh": {"dp": dp, "sp": sp, "pp": pp, "tp": tp},
+        "mesh": plan.mesh_dict(),
         "learning_rate": lr,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),

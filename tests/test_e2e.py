@@ -29,7 +29,7 @@ def _config(**over):
 
 def test_e2e_runs_and_writes_metrics(tmp_path, devices):
     result = run_e2e(_config(), output_dir=str(tmp_path), verbose=False)
-    assert result["mesh"] == {"dp": 2, "sp": 1, "pp": 1, "tp": 4}
+    assert result["mesh"] == {"dp": 2, "sp": 1, "pp": 1, "ep": 1, "tp": 4}
     assert result["forward_time"]["count"] == 3
     assert result["forward_time"]["mean"] > 0
     assert result["compile_time_s"] > 0
@@ -51,7 +51,7 @@ def test_e2e_sequence_parallel_ring(tmp_path, devices):
                      "sequence_parallel": 4},
     )
     result = run_e2e(cfg, verbose=False)
-    assert result["mesh"] == {"dp": 2, "sp": 4, "pp": 1, "tp": 1}
+    assert result["mesh"] == {"dp": 2, "sp": 4, "pp": 1, "ep": 1, "tp": 1}
     assert result["forward_time"]["mean"] > 0
 
 

@@ -1,0 +1,102 @@
+"""MoE + expert-parallelism tests: gating invariants, EP sharding
+exactness, composition with tp, and the training path (capability
+extension — the reference has no EP/MoE, SURVEY §2.2)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+from dlbb_tpu.models.configs import ModelConfig, validate_expert_parallelism
+from dlbb_tpu.models.transformer import (
+    forward,
+    init_params,
+    num_parameters,
+    shard_params,
+    top_k_gates,
+)
+from dlbb_tpu.train.loop import run_train
+
+MOE = ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
+                  ffn_intermediate=64, attention="full", dtype="float32",
+                  num_experts=4, moe_top_k=2)
+
+
+def _x(batch=8, seq=16, hidden=32, seed=1):
+    return jax.random.normal(jax.random.key(seed), (batch, seq, hidden),
+                             dtype=jnp.float32)
+
+
+def test_top_k_gates_invariants():
+    logits = jax.random.normal(jax.random.key(0), (4, 8, 6))
+    gates = top_k_gates(logits, 2)
+    # exactly k nonzeros per token, summing to 1
+    nonzeros = (np.asarray(gates) > 0).sum(-1)
+    np.testing.assert_array_equal(nonzeros, np.full((4, 8), 2))
+    np.testing.assert_allclose(np.asarray(gates.sum(-1)),
+                               np.ones((4, 8)), rtol=1e-6)
+    # top-1 selects the argmax expert
+    g1 = top_k_gates(logits, 1)
+    np.testing.assert_array_equal(
+        np.asarray(g1.argmax(-1)), np.asarray(logits.argmax(-1))
+    )
+
+
+def test_moe_param_count():
+    params = init_params(MOE, jax.random.key(0))
+    total = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+    assert total == num_parameters(MOE)
+
+
+def test_moe_ep_matches_single_device(devices):
+    """Expert-parallel sharding must not change the forward numerics."""
+    params = init_params(MOE, jax.random.key(0))
+    x = _x()
+    y_ref = jax.jit(lambda p, x: forward(p, x, MOE))(params, x)
+
+    mesh = build_mesh(MeshSpec.grid((1, 4, 2), ("dp", "ep", "tp")))
+    params_s = shard_params(params, mesh)
+    y = jax.jit(lambda p, x: forward(p, x, MOE, mesh=mesh))(params_s, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_train_loss_decreases(devices):
+    cfg = {
+        "experiment": {"name": "train_moe"},
+        "model": {
+            "hidden_size": 32, "num_layers": 2, "num_heads": 4,
+            "ffn_intermediate": 64, "attention": "full", "dtype": "float32",
+            "num_experts": 4, "moe_top_k": 2,
+        },
+        "parallelism": {"world_size": 2, "data_parallel": 2,
+                        "expert_parallel": 2},
+        "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 6},
+        "training": {"learning_rate": 1e-2},
+    }
+    result = run_train(cfg, zero_stage=1, verbose=False)
+    assert result["mesh"]["ep"] == 2
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_validate_expert_parallelism():
+    dense = MOE.with_(num_experts=0)
+    with pytest.raises(ValueError, match="requires a MoE model"):
+        validate_expert_parallelism(dense, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        validate_expert_parallelism(MOE, 3)
+    validate_expert_parallelism(MOE, 2)  # ok
+    validate_expert_parallelism(dense, 1)  # ep=1 always ok
+
+
+def test_moe_config_validation():
+    with pytest.raises(ValueError, match="moe_top_k"):
+        ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_intermediate=64, num_experts=2, moe_top_k=3)
+    with pytest.raises(ValueError, match="num_experts"):
+        ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_intermediate=64, num_experts=-1)

@@ -93,6 +93,15 @@ def test_pipeline_train_matches_plain(devices):
     )
 
 
+def test_microbatches_without_pp_rejected(devices):
+    """num_microbatches without pipeline_parallel must error, not be
+    silently ignored."""
+    cfg = _train_config(pp=1)
+    cfg["parallelism"]["num_microbatches"] = 4
+    with pytest.raises(ValueError, match="pipeline_parallel"):
+        run_train(cfg, verbose=False)
+
+
 def test_validate_pipeline_errors():
     with pytest.raises(ValueError, match="not divisible by"):
         validate_pipeline(TINY, 3, 8, None)  # 4 layers % 3 stages
