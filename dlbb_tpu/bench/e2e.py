@@ -26,7 +26,6 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.comm.mesh import build_parallelism_mesh
 from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
 from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.parallel.plan import ParallelismPlan
@@ -46,21 +45,6 @@ from dlbb_tpu.utils.timing import (
     time_fn_chained,
     time_fn_per_iter,
 )
-
-
-def build_e2e_mesh(world_size: int, data_parallel: int = 1,
-                   sequence_parallel: int = 1, pipeline_parallel: int = 1,
-                   expert_parallel: int = 1,
-                   devices: Optional[Sequence] = None):
-    """Mesh for the E2E benchmark, with tp = the reference's ``world_size``
-    (``config/baseline_config.yaml:17``); the sp axis (absent from the
-    reference, SURVEY §5.7) carries ring/Ulysses context parallelism, the
-    pp axis the microbatched pipeline (``dlbb_tpu/parallel/pipeline.py``),
-    and the ep axis MoE expert sharding."""
-    return build_parallelism_mesh(
-        data_parallel, sequence_parallel, pipeline_parallel, world_size,
-        expert_parallel, devices=devices,
-    )
 
 
 def run_e2e(
