@@ -1,0 +1,129 @@
+"""ctypes bindings for the native statistics core.
+
+The reference has no in-repo native code (SURVEY §2.4 — its native layer is
+the external MPI/oneCCL/Gloo libraries); this framework's runtime-side
+native component is ``stats_core.cpp``, compiled on first use with the
+in-image g++ (no pybind11 in this image, hence the C ABI + ctypes).
+
+Graceful degradation by design: if the toolchain or the build is
+unavailable the callers fall back to numpy, and ``DLBB_NATIVE=0`` disables
+the native path outright.  Numerics are asserted equal to numpy in
+``tests/test_native.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+_DIR = Path(__file__).resolve().parent
+_SO = _DIR / "libdlbb_stats.so"
+
+_lib: Any = None
+_tried = False
+
+SUMMARY_FIELDS = ("mean", "std", "min", "max", "median", "p95", "p99",
+                  "count")
+
+
+def _build() -> bool:
+    try:
+        proc = subprocess.run(
+            ["make", "-s", "-C", str(_DIR)],
+            capture_output=True, text=True, timeout=120,
+        )
+        return proc.returncode == 0 and _SO.exists()
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _load() -> Any:
+    """Load (building if needed) the shared library; None when
+    unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("DLBB_NATIVE", "1") == "0":
+        return None
+    if not _SO.exists() and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(str(_SO))
+    except OSError:
+        return None
+    dbl_p = ctypes.POINTER(ctypes.c_double)
+    lib.dlbb_summarize.argtypes = [dbl_p, ctypes.c_long, dbl_p]
+    lib.dlbb_summarize.restype = ctypes.c_int
+    lib.dlbb_load_imbalance.argtypes = [dbl_p, ctypes.c_long]
+    lib.dlbb_load_imbalance.restype = ctypes.c_double
+    lib.dlbb_row_means.argtypes = [dbl_p, ctypes.c_long, ctypes.c_long,
+                                   dbl_p]
+    lib.dlbb_row_means.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_c_array(values) -> tuple[Any, np.ndarray]:
+    arr = np.ascontiguousarray(np.asarray(values, dtype=np.float64))
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), arr
+
+
+def summarize_native(values) -> Optional[dict[str, float]]:
+    """Summary statistics with the metric names of
+    ``utils/metrics.summarize``; None when the native core is
+    unavailable or the input is empty."""
+    lib = _load()
+    if lib is None:
+        return None
+    ptr, arr = _as_c_array(values)
+    if arr.size == 0:
+        return None
+    out = np.empty(8, dtype=np.float64)
+    rc = lib.dlbb_summarize(
+        ptr, arr.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    )
+    if rc != 0:
+        return None
+    result = dict(zip(SUMMARY_FIELDS, (float(v) for v in out)))
+    result["count"] = int(result["count"])
+    return result
+
+
+def load_imbalance_native(rank_means) -> Optional[float]:
+    """Reference load-imbalance %% (``collectives/1d/stats.py:54-61``);
+    None when the native core is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    ptr, arr = _as_c_array(rank_means)
+    if arr.size == 0:
+        return 0.0
+    return float(lib.dlbb_load_imbalance(ptr, arr.size))
+
+
+def row_means_native(matrix) -> Optional[np.ndarray]:
+    """Per-rank means of a [ranks][iters] timing matrix; None when the
+    native core is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(np.asarray(matrix, dtype=np.float64))
+    if arr.ndim != 2 or arr.size == 0:
+        return None
+    out = np.empty(arr.shape[0], dtype=np.float64)
+    rc = lib.dlbb_row_means(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        arr.shape[0], arr.shape[1],
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+    )
+    return out if rc == 0 else None

@@ -1,0 +1,88 @@
+// Native statistics core for the metrics / stats pipelines.
+//
+// The reference keeps all statistics in Python/numpy
+// (collectives/1d/stats.py:26-129, utils.py:43-66); its native code lives
+// entirely in external comm libraries (SURVEY §2.4).  This framework's
+// runtime-side native component accelerates the one hot CPU loop the
+// harness owns — aggregating per-rank x per-iteration timing arrays into
+// summary statistics when sweeps produce thousands of result files.
+//
+// Semantics match numpy exactly where exactness is testable:
+//  - percentile: numpy's default "linear" interpolation on sorted data
+//  - std: population (ddof=0), like numpy's default
+// Exposed with a C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cmath>
+#include <vector>
+
+namespace {
+
+double percentile_sorted(const std::vector<double>& s, double q) {
+    const long n = static_cast<long>(s.size());
+    if (n == 1) return s[0];
+    const double pos = q / 100.0 * static_cast<double>(n - 1);
+    const long lo = static_cast<long>(pos);
+    const long hi = std::min(lo + 1, n - 1);
+    const double frac = pos - static_cast<double>(lo);
+    return s[lo] + (s[hi] - s[lo]) * frac;
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[8] = mean, std, min, max, median, p95, p99, count
+// returns 0 on success, -1 on bad input
+int dlbb_summarize(const double* xs, long n, double* out) {
+    if (xs == nullptr || out == nullptr || n <= 0) return -1;
+    double sum = 0.0;
+    for (long i = 0; i < n; ++i) sum += xs[i];
+    const double mean = sum / static_cast<double>(n);
+    double ss = 0.0;
+    for (long i = 0; i < n; ++i) {
+        const double d = xs[i] - mean;
+        ss += d * d;
+    }
+    std::vector<double> s(xs, xs + n);
+    std::sort(s.begin(), s.end());
+    out[0] = mean;
+    out[1] = std::sqrt(ss / static_cast<double>(n));
+    out[2] = s.front();
+    out[3] = s.back();
+    out[4] = percentile_sorted(s, 50.0);
+    out[5] = percentile_sorted(s, 95.0);
+    out[6] = percentile_sorted(s, 99.0);
+    out[7] = static_cast<double>(n);
+    return 0;
+}
+
+// Load imbalance % over per-rank mean timings:
+// (max(rank_means) - mean(rank_means)) / mean(rank_means) * 100
+// (reference formula, collectives/1d/stats.py:54-61).
+double dlbb_load_imbalance(const double* rank_means, long n) {
+    if (rank_means == nullptr || n <= 0) return 0.0;
+    double sum = 0.0, maxv = rank_means[0];
+    for (long i = 0; i < n; ++i) {
+        sum += rank_means[i];
+        if (rank_means[i] > maxv) maxv = rank_means[i];
+    }
+    const double mean = sum / static_cast<double>(n);
+    if (mean <= 0.0) return 0.0;
+    return (maxv - mean) / mean * 100.0;
+}
+
+// Row-mean reduction for [ranks][iters] timing matrices (the stats
+// pipeline's inner loop over thousands of result files).
+int dlbb_row_means(const double* xs, long rows, long cols, double* out) {
+    if (xs == nullptr || out == nullptr || rows <= 0 || cols <= 0) return -1;
+    for (long r = 0; r < rows; ++r) {
+        double sum = 0.0;
+        const double* row = xs + r * cols;
+        for (long c = 0; c < cols; ++c) sum += row[c];
+        out[r] = sum / static_cast<double>(cols);
+    }
+    return 0;
+}
+
+}  // extern "C"

@@ -18,10 +18,17 @@ import numpy as np
 
 def summarize(values: list[float]) -> dict[str, float]:
     """Summary statistics over a timing series (seconds), matching the
-    reference's metric names (``utils.py:43-66``)."""
+    reference's metric names (``utils.py:43-66``).  Uses the native C++
+    stats core when available (``dlbb_tpu/native``), numpy otherwise —
+    numerics asserted identical in ``tests/test_native.py``."""
     arr = np.asarray(values, dtype=np.float64)
     if arr.size == 0:
         return {}
+    from dlbb_tpu.native import summarize_native
+
+    native = summarize_native(arr)
+    if native is not None:
+        return native
     return {
         "mean": float(arr.mean()),
         "std": float(arr.std()),

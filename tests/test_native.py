@@ -1,0 +1,93 @@
+"""Native C++ stats core: build, bindings, and numpy-equivalence
+(the framework's runtime-side native component — SURVEY §2.4 notes the
+reference keeps its native layer in external comm libs)."""
+
+import numpy as np
+import pytest
+
+from dlbb_tpu.native import (
+    load_imbalance_native,
+    native_available,
+    row_means_native,
+    summarize_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native stats core unavailable (no g++?)"
+)
+
+RNG = np.random.default_rng(42)
+
+
+def test_summarize_matches_numpy():
+    for n in (1, 2, 7, 100, 10_001):
+        xs = RNG.lognormal(size=n)
+        got = summarize_native(xs)
+        assert got is not None
+        assert got["count"] == n
+        np.testing.assert_allclose(got["mean"], xs.mean(), rtol=1e-12)
+        np.testing.assert_allclose(got["std"], xs.std(), rtol=1e-9, atol=1e-15)
+        np.testing.assert_allclose(got["min"], xs.min(), rtol=0)
+        np.testing.assert_allclose(got["max"], xs.max(), rtol=0)
+        np.testing.assert_allclose(got["median"], np.median(xs), rtol=1e-12)
+        np.testing.assert_allclose(got["p95"], np.percentile(xs, 95),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(got["p99"], np.percentile(xs, 99),
+                                   rtol=1e-12)
+
+
+def test_summarize_used_by_metrics():
+    """utils.metrics.summarize routes through the native core and keeps
+    its schema."""
+    from dlbb_tpu.utils.metrics import summarize
+
+    xs = RNG.normal(size=256).tolist()
+    out = summarize(xs)
+    assert set(out) == {"mean", "std", "min", "max", "median", "p95",
+                        "p99", "count"}
+    np.testing.assert_allclose(out["p95"], np.percentile(xs, 95), rtol=1e-12)
+
+
+def test_load_imbalance_matches_reference_formula():
+    means = RNG.uniform(1.0, 2.0, size=16)
+    expected = (means.max() - means.mean()) / means.mean() * 100.0
+    np.testing.assert_allclose(load_imbalance_native(means), expected,
+                               rtol=1e-12)
+    assert load_imbalance_native([]) == 0.0
+
+
+def test_row_means_matches_numpy():
+    mat = RNG.normal(size=(8, 100))
+    got = row_means_native(mat)
+    np.testing.assert_allclose(got, mat.mean(axis=1), rtol=1e-12)
+
+
+def test_stats1d_pipeline_uses_native():
+    from dlbb_tpu.stats.stats1d import calculate_statistics
+
+    timings = RNG.lognormal(mean=-8, size=(4, 50))
+    stats = calculate_statistics(timings.tolist())
+    flat = timings.ravel()
+    np.testing.assert_allclose(stats["mean_time_us"], flat.mean() * 1e6,
+                               rtol=1e-9)
+    means = timings.mean(axis=1)
+    expected_li = (means.max() - means.mean()) / means.mean() * 100.0
+    np.testing.assert_allclose(stats["load_imbalance_percent"], expected_li,
+                               rtol=1e-9)
+
+
+def test_native_disabled_falls_back(monkeypatch):
+    """DLBB_NATIVE=0 must cleanly disable the native path (fresh loader
+    state) while summarize keeps working via numpy."""
+    import dlbb_tpu.native as native
+
+    monkeypatch.setenv("DLBB_NATIVE", "0")
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", False)
+    assert native.summarize_native([1.0, 2.0]) is None
+    from dlbb_tpu.utils.metrics import summarize
+
+    out = summarize([1.0, 2.0, 3.0])
+    assert out["mean"] == 2.0
+    # restore loader state for later tests
+    monkeypatch.setattr(native, "_tried", False)
