@@ -29,6 +29,14 @@ class ModelConfig:
     # SURVEY §2.2).
     num_experts: int = 0
     moe_top_k: int = 2
+    # "dense": every expert runs on every token, gates select (exact, no
+    # drops; per-device FLOPs scale with num_experts/ep).
+    # "capacity": GShard-style einsum dispatch into per-expert capacity
+    # buffers of moe_capacity_factor * S * k / E slots per sequence;
+    # over-capacity tokens are dropped (pass through the residual only) and
+    # per-device FLOPs are capacity-bounded.
+    moe_dispatch: str = "dense"
+    moe_capacity_factor: float = 1.25
 
     def __post_init__(self) -> None:
         if self.hidden_size % self.num_heads != 0:
@@ -46,6 +54,16 @@ class ModelConfig:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} must be in [1, "
                 f"num_experts={self.num_experts}]"
+            )
+        if self.moe_dispatch not in ("dense", "capacity"):
+            raise ValueError(
+                f"unknown moe_dispatch {self.moe_dispatch!r} "
+                "(expected 'dense' or 'capacity')"
+            )
+        if self.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got "
+                f"{self.moe_capacity_factor}"
             )
 
     @property
@@ -72,6 +90,7 @@ class ModelConfig:
         for k in (
             "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
             "attention", "dtype", "num_experts", "moe_top_k",
+            "moe_dispatch", "moe_capacity_factor",
         ):
             if k in d:
                 fields[k] = d[k]

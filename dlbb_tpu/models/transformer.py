@@ -186,7 +186,7 @@ def top_k_gates(logits: jax.Array, k: int) -> jax.Array:
     return gated / gated.sum(axis=-1, keepdims=True)
 
 
-def _moe_ffn(y, layer: Params, config: ModelConfig):
+def _moe_ffn_dense(y, layer: Params, config: ModelConfig):
     """Top-k gated mixture-of-experts FFN: [B, S, H] -> [B, S, H].
 
     Dense-dispatch design: every expert runs on every token and the gate
@@ -204,6 +204,57 @@ def _moe_ffn(y, layer: Params, config: ModelConfig):
                             layer["ffn_down"]["kernel"])
     per_expert = per_expert + layer["ffn_down"]["bias"][None, None, :, :]
     return jnp.einsum("bseh,bse->bsh", per_expert, gates)
+
+
+def moe_capacity(config: ModelConfig, seq_len: int) -> int:
+    """Per-expert capacity slots per sequence (GShard formula:
+    capacity_factor * tokens * k / E, floored at 1 and capped at seq_len —
+    an expert can never receive more than the group's tokens)."""
+    c = math.ceil(
+        config.moe_capacity_factor * seq_len * config.moe_top_k
+        / config.num_experts
+    )
+    return max(1, min(c, seq_len))
+
+
+def _moe_ffn_capacity(y, layer: Params, config: ModelConfig):
+    """GShard-style capacity-bounded einsum dispatch: [B, S, H] -> [B, S, H].
+
+    Each sequence is a dispatch group; every expert gets a fixed buffer of
+    ``moe_capacity(config, S)`` slots per group, tokens claim slots in
+    sequence order via a per-expert cumulative count, and over-capacity
+    tokens are dropped (they flow through the block's residual only).
+    All static shapes; per-device expert FLOPs are capacity-bounded rather
+    than all-tokens x all-experts; the combine contraction over the expert
+    dim lowers to the ``ep`` psum under GSPMD, exactly like dense dispatch.
+    """
+    b, s, _ = y.shape
+    cap = moe_capacity(config, s)
+    logits = y @ layer["router"]["kernel"]                  # [B, S, E]
+    gates = top_k_gates(logits, config.moe_top_k)           # fp32 [B, S, E]
+    mask = gates > 0
+    # slot index each token would take in each expert's queue (per group)
+    pos = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1     # [B, S, E]
+    keep = jnp.logical_and(mask, pos < cap)
+    dispatch = (
+        jax.nn.one_hot(pos, cap, dtype=y.dtype)
+        * keep[..., None].astype(y.dtype)
+    )                                                        # [B, S, E, C]
+    expert_in = jnp.einsum("bsec,bsh->bech", dispatch, y)    # [B, E, C, H]
+    up = jnp.einsum("bech,ehf->becf", expert_in,
+                    layer["ffn_up"]["kernel"])
+    up = up + layer["ffn_up"]["bias"][None, :, None, :]
+    act = jax.nn.gelu(up)
+    out = jnp.einsum("becf,efh->bech", act, layer["ffn_down"]["kernel"])
+    out = out + layer["ffn_down"]["bias"][None, :, None, :]
+    combine = dispatch * gates[..., None].astype(y.dtype)    # [B, S, E, C]
+    return jnp.einsum("bsec,bech->bsh", combine, out)
+
+
+def _moe_ffn(y, layer: Params, config: ModelConfig):
+    if config.moe_dispatch == "capacity":
+        return _moe_ffn_capacity(y, layer, config)
+    return _moe_ffn_dense(y, layer, config)
 
 
 def _block(x, layer: Params, config: ModelConfig, mesh=None,

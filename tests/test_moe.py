@@ -62,25 +62,102 @@ def test_moe_ep_matches_single_device(devices):
                                rtol=1e-5, atol=1e-5)
 
 
-def test_moe_train_loss_decreases(devices):
-    cfg = {
-        "experiment": {"name": "train_moe"},
-        "model": {
-            "hidden_size": 32, "num_layers": 2, "num_heads": 4,
-            "ffn_intermediate": 64, "attention": "full", "dtype": "float32",
-            "num_experts": 4, "moe_top_k": 2,
-        },
+def _moe_train_cfg(name="train_moe", **model_over):
+    model = {
+        "hidden_size": 32, "num_layers": 2, "num_heads": 4,
+        "ffn_intermediate": 64, "attention": "full", "dtype": "float32",
+        "num_experts": 4, "moe_top_k": 2,
+    }
+    model.update(model_over)
+    return {
+        "experiment": {"name": name},
+        "model": model,
         "parallelism": {"world_size": 2, "data_parallel": 2,
                         "expert_parallel": 2},
         "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
         "execution": {"warmup_iterations": 1, "benchmark_iterations": 6},
         "training": {"learning_rate": 1e-2},
     }
-    result = run_train(cfg, zero_stage=1, verbose=False)
+
+
+def test_moe_train_loss_decreases(devices):
+    result = run_train(_moe_train_cfg(), zero_stage=1, verbose=False)
     assert result["mesh"]["ep"] == 2
     losses = result["losses"]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_capacity_matches_dense_when_ample():
+    """With capacity >= S (cf = E/k), no token is dropped and capacity
+    dispatch must equal dense dispatch exactly."""
+    ample = MOE.with_(moe_dispatch="capacity",
+                      moe_capacity_factor=MOE.num_experts / MOE.moe_top_k)
+    params = init_params(MOE, jax.random.key(0))
+    x = _x()
+    y_dense = jax.jit(lambda p, x: forward(p, x, MOE))(params, x)
+    y_cap = jax.jit(lambda p, x: forward(p, x, ample))(params, x)
+    np.testing.assert_allclose(np.asarray(y_dense), np.asarray(y_cap),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_capacity_ep_matches_single_device(devices):
+    """Capacity dispatch stays exact under ep x tp sharding."""
+    cfg = MOE.with_(moe_dispatch="capacity", moe_capacity_factor=2.0)
+    params = init_params(cfg, jax.random.key(0))
+    x = _x()
+    y_ref = jax.jit(lambda p, x: forward(p, x, cfg))(params, x)
+
+    mesh = build_mesh(MeshSpec.grid((1, 4, 2), ("dp", "ep", "tp")))
+    params_s = shard_params(params, mesh)
+    y = jax.jit(lambda p, x: forward(p, x, cfg, mesh=mesh))(params_s, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_capacity_drops_tokens_when_tight():
+    """A tight capacity factor drops tokens (output differs from dense)
+    but stays finite — the documented GShard trade-off."""
+    tight = MOE.with_(moe_dispatch="capacity", moe_capacity_factor=0.25)
+    params = init_params(MOE, jax.random.key(0))
+    x = _x()
+    y_dense = jax.jit(lambda p, x: forward(p, x, MOE))(params, x)
+    y_cap = jax.jit(lambda p, x: forward(p, x, tight))(params, x)
+    assert np.all(np.isfinite(np.asarray(y_cap)))
+    assert not np.allclose(np.asarray(y_dense), np.asarray(y_cap))
+
+
+def test_moe_capacity_formula():
+    from dlbb_tpu.models.transformer import moe_capacity
+
+    # cf * S * k / E = 1.25 * 16 * 2 / 4 = 10
+    assert moe_capacity(MOE.with_(moe_dispatch="capacity"), 16) == 10
+    # floor at 1
+    tiny = MOE.with_(moe_dispatch="capacity", moe_capacity_factor=0.01)
+    assert moe_capacity(tiny, 16) == 1
+    # cap at seq_len — an expert can't receive more tokens than the group
+    huge = MOE.with_(moe_dispatch="capacity", moe_capacity_factor=100.0)
+    assert moe_capacity(huge, 16) == 16
+
+
+def test_capacity_train_loss_decreases(devices):
+    cfg = _moe_train_cfg(name="train_moe_cap", moe_dispatch="capacity",
+                         moe_capacity_factor=1.5)
+    result = run_train(cfg, zero_stage=1, verbose=False)
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_moe_dispatch_validation():
+    with pytest.raises(ValueError, match="moe_dispatch"):
+        ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_intermediate=64, num_experts=2,
+                    moe_dispatch="alltoall")
+    with pytest.raises(ValueError, match="capacity_factor"):
+        ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
+                    ffn_intermediate=64, num_experts=2,
+                    moe_capacity_factor=0.0)
 
 
 def test_validate_expert_parallelism():

@@ -93,6 +93,31 @@ def test_pipeline_train_matches_plain(devices):
     )
 
 
+def test_pipeline_train_zero3(devices):
+    """pp composes with ZeRO-3/FSDP: same trajectory as plain DDP."""
+    r_plain = run_train(_train_config(pp=1), verbose=False)
+    cfg = _train_config(pp=2)
+    r = run_train(cfg, zero_stage=3, verbose=False)
+    assert r["mode"] == "zero3" and r["mesh"]["pp"] == 2
+    np.testing.assert_allclose(
+        r_plain["losses"], r["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_moe_pipeline_forward(devices):
+    """MoE FFN inside the pipelined layer scan stays exact (pp x ep)."""
+    moe = TINY.with_(num_experts=4, moe_top_k=2)
+    params = init_params(moe, jax.random.key(0))
+    x = _x()
+    y_ref = jax.jit(lambda p, x: forward(p, x, moe))(params, x)
+
+    mesh = build_mesh(MeshSpec.grid((2, 2, 2), ("dp", "pp", "ep")))
+    params_s = shard_params(params, mesh)
+    y = jax.jit(lambda p, x: forward(p, x, moe, mesh=mesh))(params_s, x)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_microbatches_without_pp_rejected(devices):
     """num_microbatches without pipeline_parallel must error, not be
     silently ignored."""
