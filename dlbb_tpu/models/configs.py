@@ -33,7 +33,8 @@ class ModelConfig:
     # drops; per-device FLOPs scale with num_experts/ep).
     # "capacity": GShard-style einsum dispatch into per-expert capacity
     # buffers of moe_capacity_factor * S * k / E slots per sequence;
-    # over-capacity tokens are dropped (pass through the residual only) and
+    # over-capacity (token, expert) routing slots are dropped individually
+    # (a fully-dropped token passes through the residual only) and
     # per-device FLOPs are capacity-bounded.
     moe_dispatch: str = "dense"
     moe_capacity_factor: float = 1.25

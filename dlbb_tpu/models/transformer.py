@@ -174,19 +174,36 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
     return o.transpose(0, 2, 1, 3).reshape(b, s, n * d)
 
 
-def top_k_gates(logits: jax.Array, k: int) -> jax.Array:
-    """Sparse top-k routing weights from router logits [..., E]: full fp32
-    softmax, keep the k largest probabilities, renormalise to sum 1
-    (Mixtral-style gating).  Returns [..., E] with exactly k nonzeros."""
+def router_probs_gates(logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Full fp32 softmax router distribution and the sparse top-k routing
+    weights (k largest probabilities renormalised to sum 1 — Mixtral-style
+    gating).  Returns ``(probs, gates)``, both [..., E]; gates have exactly
+    k nonzeros."""
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     top_vals, top_idx = jax.lax.top_k(probs, k)
     mask = jax.nn.one_hot(top_idx, logits.shape[-1],
                           dtype=probs.dtype).sum(axis=-2)
     gated = probs * mask
-    return gated / gated.sum(axis=-1, keepdims=True)
+    return probs, gated / gated.sum(axis=-1, keepdims=True)
 
 
-def _moe_ffn_dense(y, layer: Params, config: ModelConfig):
+def top_k_gates(logits: jax.Array, k: int) -> jax.Array:
+    """Sparse top-k routing weights; see ``router_probs_gates``."""
+    return router_probs_gates(logits, k)[1]
+
+
+def moe_aux_loss(probs: jax.Array, gates: jax.Array, k: int) -> jax.Array:
+    """Switch-Transformer load-balancing loss, generalised to top-k:
+    ``E * sum_e f_e * P_e`` with ``f_e`` the fraction of routing slots sent
+    to expert e and ``P_e`` its mean router probability.  Equals 1.0 at
+    perfect balance, grows as routing collapses onto few experts."""
+    num_experts = probs.shape[-1]
+    f = (gates > 0).astype(jnp.float32).mean(axis=(0, 1)) / k
+    p = probs.mean(axis=(0, 1))
+    return num_experts * jnp.sum(f * p)
+
+
+def _moe_ffn_dense(y, gates32, layer: Params, config: ModelConfig):
     """Top-k gated mixture-of-experts FFN: [B, S, H] -> [B, S, H].
 
     Dense-dispatch design: every expert runs on every token and the gate
@@ -195,8 +212,7 @@ def _moe_ffn_dense(y, layer: Params, config: ModelConfig):
     dim sharded over ``ep`` each device computes only its local experts
     and the final gate contraction becomes the psum over ``ep`` (GSPMD).
     """
-    logits = y @ layer["router"]["kernel"]                  # [B, S, E]
-    gates = top_k_gates(logits, config.moe_top_k).astype(y.dtype)
+    gates = gates32.astype(y.dtype)
     up = jnp.einsum("bsh,ehf->bsef", y, layer["ffn_up"]["kernel"])
     up = up + layer["ffn_up"]["bias"][None, None, :, :]
     act = jax.nn.gelu(up)
@@ -217,21 +233,23 @@ def moe_capacity(config: ModelConfig, seq_len: int) -> int:
     return max(1, min(c, seq_len))
 
 
-def _moe_ffn_capacity(y, layer: Params, config: ModelConfig):
+def _moe_ffn_capacity(y, gates, layer: Params, config: ModelConfig):
     """GShard-style capacity-bounded einsum dispatch: [B, S, H] -> [B, S, H].
 
     Each sequence is a dispatch group; every expert gets a fixed buffer of
-    ``moe_capacity(config, S)`` slots per group, tokens claim slots in
-    sequence order via a per-expert cumulative count, and over-capacity
-    tokens are dropped (they flow through the block's residual only).
-    All static shapes; per-device expert FLOPs are capacity-bounded rather
-    than all-tokens x all-experts; the combine contraction over the expert
-    dim lowers to the ``ep`` psum under GSPMD, exactly like dense dispatch.
+    ``moe_capacity(config, S)`` slots per group, and (token, expert)
+    routing slots claim buffer slots in sequence order via a per-expert
+    cumulative count.  Over-capacity *routing slots* are dropped
+    individually: with top-k > 1 a token can lose one expert's
+    contribution while keeping another's (at its un-renormalised gate
+    weight); a token dropped by every selected expert flows through the
+    block's residual only.  All static shapes; per-device expert FLOPs are
+    capacity-bounded rather than all-tokens x all-experts; the combine
+    contraction over the expert dim lowers to the ``ep`` psum under GSPMD,
+    exactly like dense dispatch.
     """
     b, s, _ = y.shape
     cap = moe_capacity(config, s)
-    logits = y @ layer["router"]["kernel"]                  # [B, S, E]
-    gates = top_k_gates(logits, config.moe_top_k)           # fp32 [B, S, E]
     mask = gates > 0
     # slot index each token would take in each expert's queue (per group)
     pos = jnp.cumsum(mask.astype(jnp.int32), axis=1) - 1     # [B, S, E]
@@ -252,16 +270,26 @@ def _moe_ffn_capacity(y, layer: Params, config: ModelConfig):
 
 
 def _moe_ffn(y, layer: Params, config: ModelConfig):
+    """Route + dispatch: returns ``(out, aux)`` — the FFN output and the
+    layer's load-balancing loss (``moe_aux_loss``).  Routing is shared;
+    only the dispatch strategy differs between dense and capacity."""
+    logits = y @ layer["router"]["kernel"]                  # [B, S, E]
+    probs, gates = router_probs_gates(logits, config.moe_top_k)  # fp32
     if config.moe_dispatch == "capacity":
-        return _moe_ffn_capacity(y, layer, config)
-    return _moe_ffn_dense(y, layer, config)
+        out = _moe_ffn_capacity(y, gates, layer, config)
+    else:
+        out = _moe_ffn_dense(y, gates, layer, config)
+    return out, moe_aux_loss(probs, gates, config.moe_top_k)
 
 
 def _block(x, layer: Params, config: ModelConfig, mesh=None,
            sp_axis: str = "sp"):
     """One transformer block (reference ``TransformerBlock.forward``
     ``models.py:147-190``); the FFN is the gated-expert mixture when
-    ``config.num_experts > 0``."""
+    ``config.num_experts > 0``.
+
+    Returns ``(x, aux)`` — aux is the layer's MoE load-balancing loss
+    (0.0 for the dense FFN)."""
     residual = x
     y = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
     qkv = y @ layer["qkv"]["kernel"] + layer["qkv"]["bias"]
@@ -271,17 +299,19 @@ def _block(x, layer: Params, config: ModelConfig, mesh=None,
     residual = x
     y = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
     if config.is_moe:
-        x = _moe_ffn(y, layer, config) + residual
+        ffn_out, aux = _moe_ffn(y, layer, config)
+        x = ffn_out + residual
     else:
         y = y @ layer["ffn_up"]["kernel"] + layer["ffn_up"]["bias"]
         y = jax.nn.gelu(y)
         x = y @ layer["ffn_down"]["kernel"] + layer["ffn_down"]["bias"] + residual
-    return x
+        aux = jnp.zeros((), jnp.float32)
+    return x, aux
 
 
 def forward(params: Params, x: jax.Array, config: ModelConfig,
             mesh=None, sp_axis: str = "sp", pp_axis: str = PP_AXIS,
-            num_microbatches=None) -> jax.Array:
+            num_microbatches=None, with_aux: bool = False):
     """Full forward pass: scan over stacked layers + final LN
     (reference ``LLM.forward`` ``models.py:224-237``).
 
@@ -289,9 +319,18 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
     ("ring"/"ulysses") and pipeline parallelism, whose shard_maps need the
     concrete mesh.  A mesh with a >1-sized ``pp_axis`` dispatches to the
     microbatched pipeline engine (``dlbb_tpu/parallel/pipeline.py``).
+
+    ``with_aux=True`` additionally returns the layer-mean MoE
+    load-balancing loss (``moe_aux_loss``) — unsupported under pipeline
+    parallelism, whose stages do not return per-layer scalars.
     """
     if (mesh is not None and pp_axis in mesh.axis_names
             and mesh.shape[pp_axis] > 1):
+        if with_aux:
+            raise ValueError(
+                "with_aux (MoE load-balancing loss) is not supported "
+                "under pipeline parallelism"
+            )
         from dlbb_tpu.parallel.pipeline import pipeline_forward
 
         return pipeline_forward(
@@ -300,10 +339,13 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
         )
 
     def body(carry, layer):
-        return _block(carry, layer, config, mesh, sp_axis), None
+        return _block(carry, layer, config, mesh, sp_axis)
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
-    return _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    x, auxs = jax.lax.scan(body, x, params["layers"])
+    y = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if with_aux:
+        return y, auxs.mean()
+    return y
 
 
 def num_parameters(config: ModelConfig) -> int:

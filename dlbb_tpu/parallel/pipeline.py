@@ -93,7 +93,8 @@ def pipeline_forward(
 
         def local_fwd(h):
             def body(carry, layer):
-                return _block(carry, layer, config), None
+                new_h, _aux = _block(carry, layer, config)
+                return new_h, None
 
             h, _ = lax.scan(body, h, layers_local)
             return h

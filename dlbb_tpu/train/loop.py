@@ -137,12 +137,23 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
 
 def mse_loss(params, batch, targets, config: ModelConfig,
              mesh: Optional[Mesh] = None,
-             num_microbatches: Optional[int] = None) -> jax.Array:
-    pred = forward(params, batch, config, mesh=mesh,
-                   num_microbatches=num_microbatches)
-    return jnp.mean(
+             num_microbatches: Optional[int] = None,
+             moe_aux_weight: float = 0.0) -> jax.Array:
+    """MSE vs the target batch (parity with ``test/ccl.py:110``), plus the
+    weighted MoE load-balancing loss when requested
+    (``training.moe_aux_loss_weight``)."""
+    if moe_aux_weight > 0.0:
+        pred, aux = forward(params, batch, config, mesh=mesh,
+                            num_microbatches=num_microbatches,
+                            with_aux=True)
+    else:
+        pred = forward(params, batch, config, mesh=mesh,
+                       num_microbatches=num_microbatches)
+        aux = 0.0
+    mse = jnp.mean(
         (pred.astype(jnp.float32) - targets.astype(jnp.float32)) ** 2
     )
+    return mse + moe_aux_weight * aux
 
 
 def resolve_zero_stage(zero1: bool = False,
@@ -167,11 +178,13 @@ def make_train_step(
     zero1: bool = False,
     zero_stage: Optional[int] = None,
     num_microbatches: Optional[int] = None,
+    moe_aux_weight: float = 0.0,
 ):
     """Build (jitted step fn, initial sharded TrainState) for the given
     ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP).
     A mesh with a >1-sized ``pp`` axis makes the inner forward pipelined
-    (``num_microbatches`` microbatches, default one per stage)."""
+    (``num_microbatches`` microbatches, default one per stage);
+    ``moe_aux_weight`` adds the MoE load-balancing loss."""
     stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
     base_specs = specs_for_mesh(mesh, moe=config.is_moe)
@@ -199,7 +212,8 @@ def make_train_step(
 
     def step(state: TrainState, batch, targets):
         loss, grads = jax.value_and_grad(mse_loss)(
-            state.params, batch, targets, config, mesh, num_microbatches
+            state.params, batch, targets, config, mesh, num_microbatches,
+            moe_aux_weight,
         )
         if stage >= 2:
             # pin grads to the dp-sharded layout: the dp all-reduce lowers
@@ -251,6 +265,17 @@ def run_train(
 
     train_cfg = config.get("training", {})
     lr = train_cfg.get("learning_rate", 1e-3)
+    moe_aux_weight = float(train_cfg.get("moe_aux_loss_weight", 0.0))
+    if moe_aux_weight > 0.0 and not model_cfg.is_moe:
+        raise ValueError(
+            "training.moe_aux_loss_weight requires a MoE model "
+            "(model.num_experts > 0)"
+        )
+    if moe_aux_weight > 0.0 and plan.pp > 1:
+        raise ValueError(
+            "training.moe_aux_loss_weight is not supported with "
+            "pipeline_parallel > 1"
+        )
     optimizer = optax.adam(lr)
 
     params = init_params_sharded(
@@ -258,7 +283,7 @@ def run_train(
     )
     jit_step, state = make_train_step(
         model_cfg, mesh, optimizer, params, zero_stage=stage,
-        num_microbatches=num_microbatches,
+        num_microbatches=num_microbatches, moe_aux_weight=moe_aux_weight,
     )
 
     # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see

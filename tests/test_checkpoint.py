@@ -49,6 +49,30 @@ def test_save_restore_roundtrip(devices, tmp_path, zero1):
         assert a.sharding == b.sharding, (a.sharding, b.sharding)
 
 
+def test_save_restore_pp_ep_mesh(devices, tmp_path):
+    """Checkpointing preserves shardings on a pp x ep mesh too (MoE model
+    with the layer stack sharded across pipeline stages and experts
+    sharded over ep, ZeRO-3)."""
+    mesh = build_mesh(MeshSpec.grid((2, 2, 2), ("dp", "pp", "ep")))
+    moe = TINY.with_(num_experts=4, moe_top_k=2)
+    params = init_params(moe, jax.random.key(0))
+    jit_step, state = make_train_step(
+        moe, mesh, optax.adam(1e-2), params, zero_stage=3,
+    )
+    x = jax.random.normal(jax.random.key(1), (8, 16, 32))
+    y = jax.random.normal(jax.random.key(2), (8, 16, 32))
+    state, _ = jit_step(state, x, y)
+
+    with Checkpointer(CheckpointConfig(str(tmp_path / "ck"))) as ckpt:
+        assert ckpt.maybe_save(state, force=True)
+        restored = ckpt.restore(state)
+
+    assert int(restored.step) == 1
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(restored)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a.sharding == b.sharding, (a.sharding, b.sharding)
+
+
 def test_resume_continues_trajectory(devices, tmp_path):
     """save at step k, keep training to step n; a fresh state restored from
     the checkpoint and stepped n-k more times lands on the same losses."""

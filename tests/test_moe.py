@@ -149,6 +149,55 @@ def test_capacity_train_loss_decreases(devices):
     assert losses[-1] < losses[0] * 0.9, losses
 
 
+def test_aux_loss_balance_bounds():
+    """moe_aux_loss is 1.0 at perfect balance and larger when routing
+    collapses onto one expert."""
+    import jax.numpy as jnp
+
+    from dlbb_tpu.models.transformer import moe_aux_loss
+
+    E, k = 4, 1
+    # perfectly uniform router: every expert equally likely and used
+    probs = jnp.full((2, 8, E), 1.0 / E)
+    gates = jnp.zeros((2, 8, E)).at[..., :].set(
+        jnp.eye(E)[jnp.arange(16).reshape(2, 8) % E]
+    )
+    np.testing.assert_allclose(float(moe_aux_loss(probs, gates, k)), 1.0,
+                               rtol=1e-6)
+    # collapsed: all mass and all routing on expert 0
+    probs_c = jnp.zeros((2, 8, E)).at[..., 0].set(1.0)
+    gates_c = probs_c
+    np.testing.assert_allclose(float(moe_aux_loss(probs_c, gates_c, k)),
+                               float(E), rtol=1e-6)
+
+
+def test_forward_with_aux(devices):
+    params = init_params(MOE, jax.random.key(0))
+    y, aux = jax.jit(
+        lambda p, x: forward(p, x, MOE, with_aux=True)
+    )(params, _x())
+    assert y.shape == (8, 16, 32)
+    aux_val = float(aux)
+    assert np.isfinite(aux_val) and aux_val >= 1.0 - 1e-5
+
+
+def test_aux_loss_training(devices):
+    """Training with the aux loss converges and reports it; the weight
+    requires a MoE model."""
+    cfg = _moe_train_cfg(name="train_moe_aux")
+    cfg["training"]["moe_aux_loss_weight"] = 0.01
+    result = run_train(cfg, zero_stage=1, verbose=False)
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+    dense_cfg = _moe_train_cfg(name="bad", num_experts=0)
+    dense_cfg["parallelism"].pop("expert_parallel")
+    dense_cfg["training"]["moe_aux_loss_weight"] = 0.01
+    with pytest.raises(ValueError, match="requires a MoE model"):
+        run_train(dense_cfg, verbose=False)
+
+
 def test_moe_dispatch_validation():
     with pytest.raises(ValueError, match="moe_dispatch"):
         ModelConfig(hidden_size=32, num_layers=2, num_heads=4,
