@@ -179,12 +179,18 @@ def make_train_step(
     zero_stage: Optional[int] = None,
     num_microbatches: Optional[int] = None,
     moe_aux_weight: float = 0.0,
+    grad_accum: int = 1,
 ):
     """Build (jitted step fn, initial sharded TrainState) for the given
     ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP).
     A mesh with a >1-sized ``pp`` axis makes the inner forward pipelined
     (``num_microbatches`` microbatches, default one per stage);
-    ``moe_aux_weight`` adds the MoE load-balancing loss."""
+    ``moe_aux_weight`` adds the MoE load-balancing loss; ``grad_accum``
+    splits the batch into that many sequential micro-steps whose mean
+    gradient feeds one optimizer update (same numerics as the full batch
+    for mean losses, 1/grad_accum the activation memory)."""
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
     base_specs = specs_for_mesh(mesh, moe=config.is_moe)
@@ -210,11 +216,55 @@ def make_train_step(
         lambda s: NamedSharding(mesh, s), dp_specs, is_leaf=_is_spec
     )
 
-    def step(state: TrainState, batch, targets):
-        loss, grads = jax.value_and_grad(mse_loss)(
-            state.params, batch, targets, config, mesh, num_microbatches,
-            moe_aux_weight,
+    def loss_and_grads(params, batch, targets):
+        if grad_accum == 1:
+            return jax.value_and_grad(mse_loss)(
+                params, batch, targets, config, mesh, num_microbatches,
+                moe_aux_weight,
+            )
+        b = batch.shape[0]
+        if b % grad_accum != 0:
+            raise ValueError(
+                f"batch_size={b} not divisible by grad_accum={grad_accum}"
+            )
+        mb = batch.reshape(grad_accum, b // grad_accum, *batch.shape[1:])
+        mt = targets.reshape(grad_accum, b // grad_accum, *targets.shape[1:])
+
+        def acc(carry, xs):
+            loss_sum, g_sum = carry
+            x, t = xs
+            loss, g = jax.value_and_grad(mse_loss)(
+                params, x, t, config, mesh, num_microbatches,
+                moe_aux_weight,
+            )
+            if stage >= 2:
+                # keep every micro-step's grads (and thus the carry) in
+                # the dp-sharded layout, so accumulation never materialises
+                # a replicated full-size gradient pytree under ZeRO-2/3
+                g = jax.lax.with_sharding_constraint(g, grad_shardings)
+            # accumulate in fp32 regardless of params dtype — bf16 sums
+            # would round each micro-step and break full-batch equivalence
+            g_sum = jax.tree.map(
+                lambda s, gi: s + gi.astype(jnp.float32), g_sum, g
+            )
+            return (loss_sum + loss, g_sum), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
         )
+        if stage >= 2:
+            zeros = jax.lax.with_sharding_constraint(zeros, grad_shardings)
+        (loss_sum, g_sum), _ = jax.lax.scan(
+            acc, (jnp.zeros((), jnp.float32), zeros), (mb, mt)
+        )
+        inv = 1.0 / grad_accum
+        grads = jax.tree.map(
+            lambda g, p: (g * inv).astype(p.dtype), g_sum, params
+        )
+        return loss_sum * inv, grads
+
+    def step(state: TrainState, batch, targets):
+        loss, grads = loss_and_grads(state.params, batch, targets)
         if stage >= 2:
             # pin grads to the dp-sharded layout: the dp all-reduce lowers
             # to reduce-scatter and grad memory stays sharded (ZeRO-2)
@@ -276,7 +326,11 @@ def run_train(
             "training.moe_aux_loss_weight is not supported with "
             "pipeline_parallel > 1"
         )
-    optimizer = optax.adam(lr)
+    grad_accum = int(train_cfg.get("gradient_accumulation", 1))
+    from dlbb_tpu.train.optim import build_optimizer, resolve_names
+
+    optimizer = build_optimizer(train_cfg)
+    opt_name, sched_name = resolve_names(train_cfg)
 
     params = init_params_sharded(
         model_cfg, jax.random.key(inp.get("seed", 42)), mesh
@@ -284,6 +338,7 @@ def run_train(
     jit_step, state = make_train_step(
         model_cfg, mesh, optimizer, params, zero_stage=stage,
         num_microbatches=num_microbatches, moe_aux_weight=moe_aux_weight,
+        grad_accum=grad_accum,
     )
 
     # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see
@@ -361,6 +416,9 @@ def run_train(
         "resumed_from_step": resumed_from,
         "mesh": plan.mesh_dict(),
         "learning_rate": lr,
+        "optimizer": opt_name,
+        "schedule": sched_name,
+        "gradient_accumulation": grad_accum,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),
         **timing_meta,

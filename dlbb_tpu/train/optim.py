@@ -1,0 +1,64 @@
+"""Optimizer + LR-schedule construction from the ``training:`` config.
+
+The reference trains only with Adam at a fixed LR (DeepSpeed config at
+``test/ccl.py:74-89``, ``test/ds_mpi_test.py:16-24``); a complete framework
+needs the standard optimizer/schedule matrix, built here from optax:
+
+optimizer: adam (default) | adamw | sgd
+schedule:  constant (default) | cosine | warmup_cosine
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import optax
+
+OPTIMIZERS = ("adam", "adamw", "sgd")
+SCHEDULES = ("constant", "cosine", "warmup_cosine")
+DEFAULT_OPTIMIZER = "adam"
+DEFAULT_SCHEDULE = "constant"
+
+
+def resolve_names(train_cfg: dict[str, Any]) -> tuple[str, str]:
+    """(optimizer, schedule) names as build_optimizer will resolve them —
+    the single source of defaults for result metadata."""
+    return (train_cfg.get("optimizer", DEFAULT_OPTIMIZER),
+            train_cfg.get("schedule", DEFAULT_SCHEDULE))
+
+
+def build_schedule(train_cfg: dict[str, Any]) -> optax.Schedule:
+    lr = float(train_cfg.get("learning_rate", 1e-3))
+    name = train_cfg.get("schedule", DEFAULT_SCHEDULE)
+    if name == "constant":
+        return optax.constant_schedule(lr)
+    if name == "cosine":
+        decay_steps = int(train_cfg.get("decay_steps", 1000))
+        return optax.cosine_decay_schedule(lr, decay_steps)
+    if name == "warmup_cosine":
+        warmup = int(train_cfg.get("warmup_steps", 100))
+        decay_steps = int(train_cfg.get("decay_steps", 1000))
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=lr, warmup_steps=warmup,
+            decay_steps=decay_steps,
+        )
+    raise ValueError(
+        f"unknown training.schedule {name!r}; known: {SCHEDULES}"
+    )
+
+
+def build_optimizer(train_cfg: dict[str, Any]) -> optax.GradientTransformation:
+    """Build the optax optimizer described by the ``training:`` section."""
+    name = train_cfg.get("optimizer", DEFAULT_OPTIMIZER)
+    schedule = build_schedule(train_cfg)
+    if name == "adam":
+        return optax.adam(schedule)
+    if name == "adamw":
+        wd = float(train_cfg.get("weight_decay", 0.01))
+        return optax.adamw(schedule, weight_decay=wd)
+    if name == "sgd":
+        momentum = train_cfg.get("momentum", 0.9)
+        return optax.sgd(schedule, momentum=momentum)
+    raise ValueError(
+        f"unknown training.optimizer {name!r}; known: {OPTIMIZERS}"
+    )

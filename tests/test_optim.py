@@ -1,0 +1,73 @@
+"""Optimizer/schedule construction + gradient accumulation
+(capability extension — the reference trains only with fixed-LR Adam,
+``test/ccl.py:74-89``)."""
+
+import numpy as np
+import pytest
+
+from dlbb_tpu.train.loop import run_train
+from dlbb_tpu.train.optim import build_optimizer, build_schedule
+
+
+def _config(**training_over):
+    training = {"learning_rate": 1e-2}
+    training.update(training_over)
+    return {
+        "experiment": {"name": "train_optim"},
+        "model": {
+            "hidden_size": 32, "num_layers": 2, "num_heads": 4,
+            "ffn_intermediate": 64, "attention": "full", "dtype": "float32",
+        },
+        "parallelism": {"world_size": 2, "data_parallel": 4},
+        "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 6},
+        "training": training,
+    }
+
+
+def test_grad_accum_matches_full_batch(devices):
+    """Mean-of-micro-step gradients == full-batch gradient for a mean
+    loss: identical optimisation trajectory."""
+    r_full = run_train(_config(), verbose=False)
+    r_accum = run_train(_config(gradient_accumulation=4), verbose=False)
+    assert r_accum["gradient_accumulation"] == 4
+    np.testing.assert_allclose(
+        r_full["losses"], r_accum["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_grad_accum_indivisible_rejected(devices):
+    with pytest.raises(ValueError, match="not divisible"):
+        run_train(_config(gradient_accumulation=3), verbose=False)
+
+
+@pytest.mark.parametrize("training", [
+    {"optimizer": "adamw", "weight_decay": 0.01},
+    {"optimizer": "sgd", "momentum": 0.9, "learning_rate": 0.05},
+    {"schedule": "warmup_cosine", "warmup_steps": 2, "decay_steps": 20},
+])
+def test_optimizer_variants_train(devices, training):
+    result = run_train(_config(**training), verbose=False)
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_schedule_values():
+    sched = build_schedule({"learning_rate": 1.0, "schedule": "warmup_cosine",
+                            "warmup_steps": 10, "decay_steps": 100})
+    assert float(sched(0)) == 0.0
+    np.testing.assert_allclose(float(sched(10)), 1.0, rtol=1e-6)
+    assert float(sched(100)) < 0.1
+    cos = build_schedule({"learning_rate": 1.0, "schedule": "cosine",
+                          "decay_steps": 100})
+    np.testing.assert_allclose(float(cos(0)), 1.0, rtol=1e-6)
+    const = build_schedule({"learning_rate": 0.5})
+    assert float(const(12345)) == 0.5
+
+
+def test_unknown_names_rejected():
+    with pytest.raises(ValueError, match="optimizer"):
+        build_optimizer({"optimizer": "lamb"})
+    with pytest.raises(ValueError, match="schedule"):
+        build_schedule({"schedule": "linear"})
