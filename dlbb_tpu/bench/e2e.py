@@ -32,6 +32,7 @@ from dlbb_tpu.parallel.plan import ParallelismPlan
 from dlbb_tpu.models.sharding import batch_spec
 from dlbb_tpu.models.transformer import (
     forward,
+    forward_flops,
     init_params_sharded,
     num_parameters,
 )
@@ -129,6 +130,10 @@ def run_e2e(
     cv = float(host_means.std() / host_means.mean()) if host_means.mean() > 0 else 0.0
 
     tokens = (config["input"]["batch_size"] * config["input"]["sequence_length"])
+    flops = forward_flops(
+        model_cfg, config["input"]["batch_size"],
+        config["input"]["sequence_length"],
+    )
     result = {
         "experiment": config.get("experiment", {}),
         "backend": "xla_tpu",
@@ -147,6 +152,8 @@ def run_e2e(
         "cross_host_variance": variance,
         "cross_host_cv": cv,
         "tokens_per_second": tokens / local_mean,
+        "model_flops_per_forward": flops,
+        "achieved_tflops_per_second": flops / local_mean / 1e12,
         "timings": [forward_times],
         "system_info": collect_system_info(),
         "timestamp": time.time(),

@@ -38,6 +38,11 @@ class ModelConfig:
     # per-device FLOPs are capacity-bounded.
     moe_dispatch: str = "dense"
     moe_capacity_factor: float = 1.25
+    # Activation rematerialisation: recompute each block's activations in
+    # the backward pass instead of storing them (jax.checkpoint around the
+    # scanned block) — trades ~1/3 more FLOPs for O(layers) less activation
+    # HBM, the standard TPU memory/compute trade.
+    remat: bool = False
 
     def __post_init__(self) -> None:
         if self.hidden_size % self.num_heads != 0:
@@ -91,7 +96,7 @@ class ModelConfig:
         for k in (
             "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
             "attention", "dtype", "num_experts", "moe_top_k",
-            "moe_dispatch", "moe_capacity_factor",
+            "moe_dispatch", "moe_capacity_factor", "remat",
         ):
             if k in d:
                 fields[k] = d[k]

@@ -341,6 +341,10 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
     def body(carry, layer):
         return _block(carry, layer, config, mesh, sp_axis)
 
+    if config.remat:
+        # prevent_cse=False: safe and faster under lax.scan, whose loop
+        # structure already rules out the CSE the default barriers guard
+        body = jax.checkpoint(body, prevent_cse=False)
     x, auxs = jax.lax.scan(body, x, params["layers"])
     y = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     if with_aux:
@@ -365,6 +369,38 @@ def num_parameters(config: ModelConfig) -> int:
         + ffn
     )
     return L * per_layer + 2 * h  # + final LN
+
+
+def forward_flops(config: ModelConfig, batch_size: int, seq_len: int) -> int:
+    """Analytic forward-pass FLOPs for a [B, S, H] batch (matmul and
+    dispatch einsum multiply-adds counted as 2 FLOPs; layernorms, gelu,
+    softmax, and gating omitted — sub-percent).  Used for
+    achieved-TFLOP/s reporting in the harnesses."""
+    h, f, L = config.hidden_size, config.ffn_intermediate, config.num_layers
+    tokens = batch_size * seq_len
+    qkv = 2 * tokens * h * 3 * h
+    out = 2 * tokens * h * h
+    if config.attention == "simplified":
+        attn = 0  # the reference's shortcut has no attention matmuls
+    else:
+        attn = 4 * batch_size * seq_len * seq_len * h  # QK^T + AV
+    if config.is_moe:
+        E = config.num_experts
+        router = 2 * tokens * h * E
+        if config.moe_dispatch == "capacity":
+            cap = moe_capacity(config, seq_len)
+            slots = batch_size * E * cap
+            # the one-hot dispatch and combine einsums
+            # ('bsec,bsh->bech' / 'bsec,bech->bsh') are dense over
+            # [B, S, E, C] x H and dominate for long sequences
+            dispatch = 2 * (2 * tokens * E * cap * h)
+        else:
+            slots = tokens * E
+            dispatch = 2 * tokens * E * h  # gate combine 'bseh,bse->bsh'
+        ffn = router + dispatch + 2 * slots * h * f * 2
+    else:
+        ffn = 2 * tokens * h * f * 2
+    return L * (qkv + attn + out + ffn)
 
 
 def shard_params(params: Params, mesh: Mesh, tp_axis: str = "tp") -> Params:

@@ -96,6 +96,8 @@ def pipeline_forward(
                 new_h, _aux = _block(carry, layer, config)
                 return new_h, None
 
+            if config.remat:
+                body = jax.checkpoint(body, prevent_cse=False)
             h, _ = lax.scan(body, h, layers_local)
             return h
 

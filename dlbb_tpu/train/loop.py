@@ -227,6 +227,13 @@ def make_train_step(
             raise ValueError(
                 f"batch_size={b} not divisible by grad_accum={grad_accum}"
             )
+        if (b // grad_accum) % dp_size != 0:
+            raise ValueError(
+                f"micro-batch size {b // grad_accum} (batch_size={b} / "
+                f"grad_accum={grad_accum}) not divisible by dp={dp_size}; "
+                "each micro-step would silently reshard the batch instead "
+                "of keeping the dp layout"
+            )
         mb = batch.reshape(grad_accum, b // grad_accum, *batch.shape[1:])
         mt = targets.reshape(grad_accum, b // grad_accum, *targets.shape[1:])
 

@@ -18,18 +18,26 @@ OPTIMIZERS = ("adam", "adamw", "sgd")
 SCHEDULES = ("constant", "cosine", "warmup_cosine")
 DEFAULT_OPTIMIZER = "adam"
 DEFAULT_SCHEDULE = "constant"
+DEFAULT_LR = 1e-3
 
 
 def resolve_names(train_cfg: dict[str, Any]) -> tuple[str, str]:
-    """(optimizer, schedule) names as build_optimizer will resolve them —
-    the single source of defaults for result metadata."""
+    """(optimizer, schedule) names as build_optimizer resolves them — the
+    single source of truth: build_optimizer/build_schedule read the names
+    through this function, so metadata can never disagree with the built
+    optimizer."""
     return (train_cfg.get("optimizer", DEFAULT_OPTIMIZER),
             train_cfg.get("schedule", DEFAULT_SCHEDULE))
 
 
+def learning_rate(train_cfg: dict[str, Any]) -> float:
+    """The configured (peak) learning rate."""
+    return float(train_cfg.get("learning_rate", DEFAULT_LR))
+
+
 def build_schedule(train_cfg: dict[str, Any]) -> optax.Schedule:
-    lr = float(train_cfg.get("learning_rate", 1e-3))
-    name = train_cfg.get("schedule", DEFAULT_SCHEDULE)
+    lr = learning_rate(train_cfg)
+    _, name = resolve_names(train_cfg)
     if name == "constant":
         return optax.constant_schedule(lr)
     if name == "cosine":
@@ -49,7 +57,7 @@ def build_schedule(train_cfg: dict[str, Any]) -> optax.Schedule:
 
 def build_optimizer(train_cfg: dict[str, Any]) -> optax.GradientTransformation:
     """Build the optax optimizer described by the ``training:`` section."""
-    name = train_cfg.get("optimizer", DEFAULT_OPTIMIZER)
+    name, _ = resolve_names(train_cfg)
     schedule = build_schedule(train_cfg)
     if name == "adam":
         return optax.adam(schedule)
