@@ -16,6 +16,7 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
+import uuid
 from pathlib import Path
 from typing import Any, Optional
 
@@ -32,14 +33,25 @@ SUMMARY_FIELDS = ("mean", "std", "min", "max", "median", "p95", "p99",
 
 
 def _build() -> bool:
+    """Compile to a globally-unique temp file, then atomically rename into
+    place: concurrent builders (parallel pytest, multi-host launch on a
+    shared FS) each produce a complete .so and the rename is last-writer-
+    wins — no process can ever dlopen a torn file.  The build recipe lives
+    only in the Makefile (``OUT=`` selects the temp output name)."""
+    tmp = _DIR / f".libdlbb_stats.{uuid.uuid4().hex}.so"
     try:
         proc = subprocess.run(
-            ["make", "-s", "-C", str(_DIR)],
+            ["make", "-s", "-C", str(_DIR), f"OUT={tmp.name}"],
             capture_output=True, text=True, timeout=120,
         )
-        return proc.returncode == 0 and _SO.exists()
+        if proc.returncode != 0 or not tmp.exists():
+            return False
+        os.replace(tmp, _SO)  # atomic on the same filesystem
+        return True
     except (OSError, subprocess.SubprocessError):
         return False
+    finally:
+        tmp.unlink(missing_ok=True)
 
 
 def _load() -> Any:

@@ -31,6 +31,7 @@ hand-written collective schedule:
 from __future__ import annotations
 
 import time
+import warnings
 from pathlib import Path
 from typing import Any, NamedTuple, Optional, Sequence
 
@@ -228,12 +229,28 @@ def make_train_step(
                 f"batch_size={b} not divisible by grad_accum={grad_accum}"
             )
         if (b // grad_accum) % dp_size != 0:
-            raise ValueError(
-                f"micro-batch size {b // grad_accum} (batch_size={b} / "
-                f"grad_accum={grad_accum}) not divisible by dp={dp_size}; "
-                "each micro-step would silently reshard the batch instead "
-                "of keeping the dp layout"
-            )
+            if config.attention in ("full", "simplified"):
+                # dense attention: numerics stay exact — GSPMD reshards
+                # each micro-batch onto the dp axis — but the layout churn
+                # costs collectives, so surface it without rejecting
+                warnings.warn(
+                    f"micro-batch size {b // grad_accum} (batch_size={b} / "
+                    f"grad_accum={grad_accum}) not divisible by "
+                    f"dp={dp_size}; each micro-step reshards the batch "
+                    "instead of keeping the dp layout (correct but slower)",
+                    stacklevel=2,
+                )
+            else:
+                # flash/ring/ulysses shard_map the batch dim over dp
+                # explicitly and cannot reshard — reject with a clear error
+                # instead of letting shard_map fail cryptically at trace
+                raise ValueError(
+                    f"micro-batch size {b // grad_accum} (batch_size={b} / "
+                    f"grad_accum={grad_accum}) not divisible by "
+                    f"dp={dp_size}: attention={config.attention!r} "
+                    "partitions the batch over dp inside shard_map and "
+                    "cannot reshard a smaller micro-batch"
+                )
         mb = batch.reshape(grad_accum, b // grad_accum, *batch.shape[1:])
         mt = targets.reshape(grad_accum, b // grad_accum, *targets.shape[1:])
 
@@ -334,6 +351,23 @@ def run_train(
             "pipeline_parallel > 1"
         )
     grad_accum = int(train_cfg.get("gradient_accumulation", 1))
+    if grad_accum > 1:
+        bs = inp["batch_size"]
+        if bs % grad_accum != 0:
+            raise ValueError(
+                f"batch_size={bs} not divisible by "
+                f"gradient_accumulation={grad_accum}"
+            )
+        if plan.pp > 1:
+            # training feeds batch/grad_accum rows to each pipelined
+            # micro-step, so the microbatch schedule must also divide the
+            # accumulation micro-batch — a training-only constraint, checked
+            # here (not in the shared plan) so forward-only harnesses that
+            # reuse a training config are unaffected
+            from dlbb_tpu.parallel.pipeline import validate_pipeline
+
+            validate_pipeline(model_cfg, plan.pp, bs // grad_accum,
+                              plan.num_microbatches)
     from dlbb_tpu.train.optim import build_optimizer, resolve_names
 
     optimizer = build_optimizer(train_cfg)

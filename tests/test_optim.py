@@ -41,6 +41,47 @@ def test_grad_accum_indivisible_rejected(devices):
         run_train(_config(gradient_accumulation=3), verbose=False)
 
 
+def test_grad_accum_dp_reshard_warns(devices):
+    """A micro-batch smaller than dp is legal (GSPMD reshards, numerics
+    exact) but surfaced as a layout-churn warning, not an error."""
+    with pytest.warns(UserWarning, match="not divisible by dp"):
+        run_train(_config(gradient_accumulation=4), verbose=False)
+
+
+def test_grad_accum_dp_shardmap_attention_rejected(devices):
+    """shard_map attention modes partition the batch over dp and cannot
+    reshard a too-small micro-batch: clear ValueError, not a cryptic
+    shard_map trace error."""
+    cfg = _config(gradient_accumulation=4)
+    cfg["model"]["attention"] = "ring"
+    cfg["parallelism"] = {"world_size": 1, "data_parallel": 4,
+                          "sequence_parallel": 2}
+    with pytest.raises(ValueError, match="cannot reshard"):
+        run_train(cfg, verbose=False)
+
+
+def test_pipeline_grad_accum_microbatch_validated(devices):
+    """Training validates the pipeline microbatch schedule against the
+    accumulation micro-step batch (batch/grad_accum) up front, instead of
+    failing at trace time inside the micro-step — while the shared plan
+    (also used by forward-only harnesses) keeps validating the full batch."""
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.parallel.plan import ParallelismPlan
+
+    cfg = _config(gradient_accumulation=4)
+    cfg["parallelism"] = {"world_size": 1, "pipeline_parallel": 2,
+                          "num_microbatches": 4}
+    # the forward-only plan is untouched by the training-only grad_accum
+    # key: 4 microbatches divide the full batch of 8
+    model_cfg = ModelConfig.from_dict(cfg["model"])
+    plan = ParallelismPlan.from_config(cfg, model_cfg)
+    assert plan.num_microbatches == 4
+    # but training micro-steps 8/4 = 2 rows, which 4 microbatches cannot
+    # divide — rejected before any compile
+    with pytest.raises(ValueError, match="not divisible"):
+        run_train(cfg, verbose=False)
+
+
 @pytest.mark.parametrize("training", [
     {"optimizer": "adamw", "weight_decay": 0.01},
     {"optimizer": "sgd", "momentum": 0.9, "learning_rate": 0.05},
