@@ -90,6 +90,11 @@ def run_e2e(
     execution = config.get("execution", {})
     warmup = execution.get("warmup_iterations", 5)
     iters = execution.get("benchmark_iterations", 10)
+    # variant-tuned XLA compilation, same contract as run_train
+    comp_opts = {
+        str(k): str(v)
+        for k, v in (execution.get("compiler_options") or {}).items()
+    }
 
     # The model maps [B,S,H] -> [B,S,H], so chained timing on remote-async
     # backends feeds the output straight back as the next input.
@@ -97,12 +102,16 @@ def run_e2e(
 
     with annotate("compile+warmup"):
         t0 = time.perf_counter()
+        if comp_opts and mode == "per_iter":
+            step = step.lower(params, batch).compile(
+                compiler_options=comp_opts
+            )
         force_completion(step(params, batch))
         compile_time = time.perf_counter() - t0
 
     with annotate("measure"):
         if mode == "per_iter":
-            forward_times = time_fn_per_iter(
+            forward_times, _, _ = time_fn_per_iter(
                 step, params, batch, warmup=max(0, warmup - 1),
                 iterations=iters
             )
@@ -114,6 +123,7 @@ def run_e2e(
             forward_times, timing_meta = time_fn_chained(
                 step, batch, warmup=1, iterations=iters,
                 chunk_size=min(5, iters), op_args=(params,),
+                compiler_options=comp_opts or None,
             )
 
     # cross-host spread of mean forward time (run_mpi.py:199-212 analogue)
@@ -145,6 +155,7 @@ def run_e2e(
         },
         "mesh": plan.mesh_dict(),
         "init_time_s": init_time,
+        "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
         "forward_time": summarize(forward_times),
         **timing_meta,

@@ -105,6 +105,12 @@ class Sweep1D:
     root: int = 0
     # "auto" | "per_iter" | "chained" — see dlbb_tpu.utils.timing
     timing_mode: str = "auto"
+    # wall-time cap per config; iteration counts scale down to fit (actual
+    # counts recorded in the result JSON) — for slow hosts / huge payloads
+    max_config_seconds: Optional[float] = None
+    # skip configs whose estimated global input+output footprint exceeds
+    # this (host-simulated meshes hold every shard in one RAM pool)
+    max_global_bytes: Optional[int] = None
 
     kind: str = "1d"
 
@@ -126,6 +132,8 @@ class Sweep3D:
     output_dir: str = "results/3d"
     root: int = 0
     timing_mode: str = "auto"
+    max_config_seconds: Optional[float] = None
+    max_global_bytes: Optional[int] = None
 
     kind: str = "3d"
 
@@ -222,6 +230,16 @@ def run_sweep(
             continue
         axes = spec.axis_names
         for config in _iter_configs(sweep):
+            if sweep.max_global_bytes is not None:
+                est = _estimate_global_bytes(sweep, config, num_ranks)
+                if est > sweep.max_global_bytes:
+                    if verbose:
+                        print(
+                            f"[skip-mem] {config['operation']} ranks="
+                            f"{num_ranks} {config}: ~{est / 2**30:.1f} GiB "
+                            f"> cap {sweep.max_global_bytes / 2**30:.1f} GiB"
+                        )
+                    continue
             try:
                 path = _run_one(
                     sweep, variant, impl, mesh, axes, num_ranks, config,
@@ -234,6 +252,19 @@ def run_sweep(
                     traceback.print_exc()
                 continue
     return written
+
+
+def _estimate_global_bytes(sweep, config, num_ranks: int) -> int:
+    """Rough global input+output footprint of one config: per_peer inputs
+    and (all)gather/alltoall outputs scale with P^2 x payload."""
+    op = get_op(config["operation"])
+    n = (config["num_elements"] if sweep.kind == "1d"
+         else config["batch"] * config["seq_len"] * config["hidden_dim"])
+    itemsize = jnp.dtype(_dtype_of(sweep.dtype)).itemsize
+    p = num_ranks
+    in_mult = p * p if op.input_kind == "per_peer" else p
+    out_mult = p * p if op.name in ("allgather", "gather", "alltoall") else p
+    return (in_mult + out_mult) * n * itemsize
 
 
 def _iter_configs(sweep):
@@ -282,6 +313,11 @@ def _run_one(
         warmup=sweep.warmup_iterations,
         iterations=sweep.measurement_iterations,
         mode=sweep.timing_mode,
+        max_seconds=sweep.max_config_seconds,
+        compiler_options=(
+            dict(variant.compiler_options) if variant.compiler_options
+            else None
+        ),
     )
     timings = _gather_timings(local)
 

@@ -24,8 +24,8 @@ def measure_torch_cpu_forward(
     ffn_intermediate: int,
     batch_size: int,
     seq_length: int,
-    warmup: int = 1,
-    iterations: int = 2,
+    warmup: int = 2,
+    iterations: int = 10,
     threads: int | None = None,
 ) -> dict[str, Any]:
     import torch
@@ -84,8 +84,11 @@ def measure_torch_cpu_forward(
     mean = sum(times) / len(times)
     return {
         "forward_mean_s": mean,
+        "forward_min_s": min(times),
+        "forward_max_s": max(times),
         "tokens_per_second": batch_size * seq_length / mean,
         "iterations": iterations,
+        "warmup_iterations": warmup,
         "torch_version": torch.__version__,
         "threads": torch.get_num_threads(),
         "config": {

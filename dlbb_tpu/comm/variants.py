@@ -43,6 +43,10 @@ class Variant:
     hierarchical: bool = False
     # XLA_FLAGS fragments a launcher must set before process start
     xla_flags: tuple[str, ...] = ()
+    # per-computation XLA compiler options (jit(...).lower().compile(...)),
+    # applied by the sweep/e2e/train harnesses — unlike xla_flags these need
+    # no relaunch and work on any PJRT backend that knows the option
+    compiler_options: tuple[tuple[str, str], ...] = ()
     # extra metadata recorded into result JSON, as (key, value) pairs so the
     # frozen dataclass stays hashable
     extra: tuple[tuple[str, str], ...] = ()
@@ -72,6 +76,36 @@ VARIANTS: dict[str, Variant] = {
         "ring",
         "flat 1D ring mesh — explicit analogue of CCL_ALLREDUCE=ring",
     ),
+    "grid2x4": Variant(
+        "grid2x4",
+        "2x4 mesh (outer-major axis order), joint reduction over both axes "
+        "(1D-ring vs 2D-mesh shape axis)",
+        mesh_shape=(2, 4),
+        mesh_axis_names=("outer", "inner"),
+    ),
+    "grid4x2": Variant(
+        "grid4x2",
+        "4x2 mesh — axis-order transpose of grid2x4; device order differs, "
+        "so the collective schedule XLA derives differs",
+        mesh_shape=(4, 2),
+        mesh_axis_names=("outer", "inner"),
+    ),
+    "hier2x4": Variant(
+        "hier2x4",
+        "2x4 mesh, explicit per-axis hierarchical psum: outer(2) then "
+        "inner(4)",
+        mesh_shape=(2, 4),
+        mesh_axis_names=("outer", "inner"),
+        hierarchical=True,
+    ),
+    "hier4x2": Variant(
+        "hier4x2",
+        "4x2 mesh, explicit per-axis hierarchical psum: outer(4) then "
+        "inner(2) — reduction-order transpose of hier2x4",
+        mesh_shape=(4, 2),
+        mesh_axis_names=("outer", "inner"),
+        hierarchical=True,
+    ),
     "grid2x2x2": Variant(
         "grid2x2x2",
         "2x2x2 mesh, joint reduction over all axes (CCL_ALLREDUCE=2d analogue; "
@@ -87,14 +121,31 @@ VARIANTS: dict[str, Variant] = {
         mesh_axis_names=("x", "y", "z"),
         hierarchical=True,
     ),
+    "nofuse": Variant(
+        "nofuse",
+        "collective-combiner HLO passes disabled (CCL_FUSION_ENABLE=0 "
+        "analogue) — per-computation compiler option, no relaunch needed; "
+        "measurable on many-collective programs (DDP/ZeRO train steps)",
+        compiler_options=(
+            ("xla_disable_hlo_passes",
+             "all-reduce-combiner,all-gather-combiner,reduce-scatter-combiner"),
+        ),
+    ),
+    # Threshold tuning (CCL_FUSION_BYTES_THRESHOLD analogue) exists only as
+    # process-start XLA_FLAGS on real TPU pods; this image's PJRT plugin
+    # exposes no combiner-threshold compile option (verified: both XLA_FLAGS
+    # parsing and compiler_options reject it), so these stay launcher
+    # metadata for pod runs (launch/launch_tpu_pod.sh).
     "combine4mb": Variant(
         "combine4mb",
-        "all-reduce combiner threshold 4 MiB (CCL_FUSION_BYTES_THRESHOLD analogue)",
+        "all-reduce combiner threshold 4 MiB (CCL_FUSION_BYTES_THRESHOLD "
+        "analogue; pod-launcher XLA_FLAGS, not executable on this image)",
         xla_flags=("--xla_tpu_all_reduce_combine_threshold_bytes=4194304",),
     ),
     "combine128mb": Variant(
         "combine128mb",
-        "all-reduce combiner threshold 128 MiB",
+        "all-reduce combiner threshold 128 MiB (pod-launcher XLA_FLAGS, not "
+        "executable on this image)",
         xla_flags=("--xla_tpu_all_reduce_combine_threshold_bytes=134217728",),
     ),
 }

@@ -45,7 +45,11 @@ from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
 from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.parallel.plan import ParallelismPlan
 from dlbb_tpu.models.sharding import batch_spec, param_specs, specs_for_mesh
-from dlbb_tpu.models.transformer import forward, init_params_sharded
+from dlbb_tpu.models.transformer import (
+    forward,
+    forward_flops,
+    init_params_sharded,
+)
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import summarize
 from dlbb_tpu.utils.profiling import annotate, step_annotation
@@ -169,6 +173,11 @@ def resolve_zero_stage(zero1: bool = False,
 
 
 MODE_NAMES = {0: "ddp", 1: "zero1", 2: "zero2", 3: "zero3"}
+
+# Approximate per-parameter update FLOPs for the utilisation accounting
+# (elementwise moment updates + bias correction + apply; small vs the 3x
+# forward term for any real model).
+OPTIMIZER_FLOPS_PER_PARAM = {"adam": 18, "adamw": 22, "sgd": 6}
 
 
 def make_train_step(
@@ -401,8 +410,22 @@ def run_train(
     mode = resolve_timing_mode("auto")
 
     batch, tgt = data.get_batch(), targets.get_batch()
+    # variant-tuned XLA compilation (e.g. the "nofuse" combiner-passes-off
+    # variant, dlbb_tpu/comm/variants.py) — per-computation compiler options
+    # need no process relaunch, unlike XLA_FLAGS
+    comp_opts = {
+        str(k): str(v)
+        for k, v in (execution.get("compiler_options") or {}).items()
+    }
     with annotate("compile+warmup"):
         t0 = time.perf_counter()
+        if comp_opts and mode == "per_iter":
+            # AOT-compile with the options; in chained mode the options are
+            # instead applied to the outer timing loop (an AOT executable
+            # cannot be traced inside it)
+            jit_step = jit_step.lower(state, batch, tgt).compile(
+                compiler_options=comp_opts
+            )
         state, loss = jit_step(state, batch, tgt)
         float(loss)  # forces completion on any backend
         compile_time = time.perf_counter() - t0
@@ -443,11 +466,25 @@ def run_train(
             step_times, timing_meta = time_fn_chained(
                 timed_step, state, warmup=1, iterations=iters,
                 chunk_size=min(5, iters), op_args=(batch, tgt),
+                compiler_options=comp_opts or None,
             )
 
     if ckpt is not None:
         ckpt.maybe_save(state, force=True)
         ckpt.close()
+
+    # Utilisation accounting (the train-side analogue of the E2E harness's
+    # achieved-TFLOP/s; parity depth with reference ``run_mpi.py:217-225``):
+    # backward ≈ 2x forward (grads w.r.t. weights + activations), plus the
+    # per-param optimizer update.  Token count per optimizer step is the
+    # full batch regardless of grad_accum/pipeline microbatching.
+    tokens = inp["batch_size"] * inp["sequence_length"]
+    n_params = int(sum(x.size for x in jax.tree.leaves(state.params)))
+    fwd_flops = forward_flops(model_cfg, inp["batch_size"],
+                              inp["sequence_length"])
+    step_flops = 3 * fwd_flops + OPTIMIZER_FLOPS_PER_PARAM.get(
+        opt_name, 18) * n_params
+    mean_step = float(np.mean(step_times))
 
     result = {
         "experiment": config.get("experiment", {}),
@@ -460,8 +497,14 @@ def run_train(
         "optimizer": opt_name,
         "schedule": sched_name,
         "gradient_accumulation": grad_accum,
+        "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),
+        "num_params": n_params,
+        "tokens_per_second": tokens / mean_step,
+        "model_flops_per_step": step_flops,
+        "forward_flops": fwd_flops,
+        "achieved_tflops_per_second": step_flops / mean_step / 1e12,
         **timing_meta,
         "losses": losses,
         "final_step": int(state.step),
@@ -472,6 +515,8 @@ def run_train(
         st = result["step_time"]
         print(
             f"[train/{result['mode']}] step mean {st['mean'] * 1e3:.2f} ms, "
+            f"{result['tokens_per_second']:.0f} tok/s, "
+            f"{result['achieved_tflops_per_second']:.2f} TFLOP/s, "
             f"loss {losses[0]:.4f} -> {losses[-1]:.4f}"
         )
     if output_dir is not None:

@@ -79,16 +79,41 @@ def calibrate_fetch_overhead(x: Any, trials: int = 5) -> float:
     return best
 
 
-def time_fn_per_iter(fn, *args, warmup: int, iterations: int) -> list[float]:
-    """Per-iteration block_until_ready timing (sync backends)."""
-    for _ in range(warmup):
+def time_fn_per_iter(
+    fn, *args, warmup: int, iterations: int,
+    max_seconds: Optional[float] = None,
+) -> tuple[list[float], int, bool]:
+    """Per-iteration block_until_ready timing (sync backends).
+
+    ``max_seconds`` caps the *measurement* wall time: after the compile
+    warmup, one probe iteration estimates the per-iteration cost and the
+    warmup/iteration counts are scaled down to fit the budget (floor of 3
+    measured iterations, never more than requested).  The actual counts are
+    returned/recorded so result artifacts never overstate the sample size.
+    Returns ``(timings, warmup_run, clamped)``.
+    """
+    jax.block_until_ready(fn(*args))  # compile + first warmup
+    warmup_run = 1
+    clamped = False
+    if max_seconds is not None:
+        t0 = time.perf_counter()
         jax.block_until_ready(fn(*args))
+        probe = time.perf_counter() - t0
+        warmup_run += 1
+        affordable = max(3, int(max_seconds / max(probe, 1e-9)))
+        if affordable < warmup + iterations:
+            clamped = True
+            warmup = min(warmup, max(0, affordable // 10))
+            iterations = min(iterations, max(3, affordable - warmup))
+    for _ in range(max(0, warmup - warmup_run)):
+        jax.block_until_ready(fn(*args))
+        warmup_run += 1
     out = []
     for _ in range(iterations):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(*args))
         out.append(time.perf_counter() - t0)
-    return out
+    return out, warmup_run, clamped
 
 
 def time_fn_chained(
@@ -99,6 +124,8 @@ def time_fn_chained(
     iterations: int = 100,
     chunk_size: Optional[int] = None,
     op_args: tuple = (),
+    compiler_options: Optional[dict[str, str]] = None,
+    max_seconds: Optional[float] = None,
 ) -> tuple[list[float], dict[str, Any]]:
     """Chunked fori_loop timing (remote-async backends).
 
@@ -124,10 +151,25 @@ def time_fn_chained(
             0, chunk_size, lambda i, c: body(args, c), x0
         )
     )
+    if compiler_options:
+        # variant-tuned compilation (e.g. combiner passes disabled) — the
+        # options must go on the outer loop jit, which subsumes the op
+        looped = looped.lower(op_args, x).compile(
+            compiler_options=dict(compiler_options)
+        )
 
+    warm_wall = float("inf")
     for _ in range(max(1, warmup)):
+        t0 = time.perf_counter()
         _force(looped(op_args, x))
+        warm_wall = min(warm_wall, time.perf_counter() - t0)
     overhead = calibrate_fetch_overhead(x)
+
+    clamped = False
+    if max_seconds is not None and warm_wall > 0:
+        affordable = max(1, int(max_seconds / warm_wall))
+        if affordable < chunks:
+            chunks, clamped = affordable, True
 
     samples = []
     for _ in range(chunks):
@@ -146,6 +188,12 @@ def time_fn_chained(
         "chunk_size": chunk_size,
         "fetch_overhead_s": overhead,
     }
+    if clamped:
+        meta.update(
+            measurement_iterations=chunks * chunk_size,
+            time_budget_s=max_seconds,
+            time_budget_clamped=True,
+        )
     return samples, meta
 
 
@@ -156,16 +204,40 @@ def time_collective(
     warmup: int = 10,
     iterations: int = 100,
     mode: str = "auto",
+    max_seconds: Optional[float] = None,
+    compiler_options: Optional[dict[str, str]] = None,
 ) -> tuple[list[float], dict[str, Any]]:
-    """Unified entry: returns (per-iteration timings, metadata)."""
+    """Unified entry: returns (per-iteration timings, metadata).
+
+    ``max_seconds`` bounds the measurement wall time per config (slow hosts /
+    huge payloads): iteration counts are scaled down to fit and the *actual*
+    counts land in the metadata, overriding the sweep's nominal ones in the
+    result JSON.  ``compiler_options`` compiles the op (or the chained loop
+    around it) with variant-specific XLA options.
+    """
     mode = resolve_timing_mode(mode)
     if mode == "per_iter":
-        timings = time_fn_per_iter(op, x, warmup=warmup, iterations=iterations)
-        return timings, {
+        if compiler_options and hasattr(op, "lower"):
+            op = op.lower(x).compile(compiler_options=dict(compiler_options))
+        timings, warmup_run, clamped = time_fn_per_iter(
+            op, x, warmup=warmup, iterations=iterations,
+            max_seconds=max_seconds,
+        )
+        meta = {
             "timing_mode": "per_iter",
             "timing_method": "time.perf_counter() + jax.block_until_ready()",
             "timing_granularity": "per_iteration",
         }
+        if clamped:
+            meta.update(
+                measurement_iterations=len(timings),
+                warmup_iterations=warmup_run,
+                time_budget_s=max_seconds,
+                time_budget_clamped=True,
+            )
+        return timings, meta
     return time_fn_chained(
-        op, x, chain=chain, warmup=max(1, warmup // 10), iterations=iterations
+        op, x, chain=chain, warmup=max(1, warmup // 10),
+        iterations=iterations, compiler_options=compiler_options,
+        max_seconds=max_seconds,
     )

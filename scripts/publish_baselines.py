@@ -1,0 +1,265 @@
+#!/usr/bin/env python
+"""Publish the in-repo baseline artifact corpus.
+
+The reference's §6 baseline IS its checked-in artifacts (~1,700 result/stats
+files under ``collectives/1d/results+stats`` and ``collectives/3d/...``).
+This driver produces the dlbb_tpu analogue and is the provenance record for
+everything under ``results/`` and ``stats/``:
+
+- ``results/1d/xla_tpu/``        canonical reference grid (8 ops x
+  {1KB,64KB,1MB,16MB} x ranks {2,4,8}) plus the extended
+  {64MB,256MB,1GB} sizes of the north-star curve (BASELINE.json metric)
+- ``results/3d/xla_tpu/``        reference 3D grid (5 ops x B x S x H x
+  ranks {4,8}, ``collectives/3d/openmpi.py:19-31``)
+- ``results/variants/<impl>/``   allreduce tuning matrix over the executable
+  variants (mesh topology / axis order / hierarchical / fusion-off) — the
+  analogue of the reference's ``dsccl_{ring,rabs,...}`` result dirs
+  (``collectives/3d/launch_dsccl.sh:34-65``)
+- ``results/train/``             ZeRO-ladder train benchmarks incl. the
+  fusion on/off (combiner-passes) comparison
+- ``stats/...``                  the stats pipelines run over all of the
+  above (reference ``collectives/{1d,3d}/stats.py`` schema)
+
+Everything runs on the CPU-simulated 8-device mesh (this image has one TPU
+chip; collectives are degenerate on one device — SURVEY §4's
+"multi-node without a cluster" model).  The host has ONE core, so the sweeps
+are time-budgeted: per-config measurement is capped (``max_config_seconds``)
+and iteration counts recorded in each artifact are the actual ones.  Configs
+whose global footprint would not fit host RAM are skipped
+(``max_global_bytes``), mirroring the reference's per-config error-skip.
+
+Usage: python scripts/publish_baselines.py [--stage 1d|3d|variants|train|stats|baseline|all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
+
+force_cpu_simulation(8)
+
+from dlbb_tpu.bench.runner import (  # noqa: E402
+    DATA_SIZES_1D,
+    EXTENDED_DATA_SIZES_1D,
+    Sweep1D,
+    Sweep3D,
+    run_sweep,
+)
+
+RESULTS = REPO / "results"
+STATS = REPO / "stats"
+
+GIB = 2**30
+
+# Executable variant matrix (the fusion/threshold XLA_FLAGS variants need a
+# real pod launcher and are excluded — see dlbb_tpu/comm/variants.py).
+EXECUTABLE_VARIANTS = (
+    "default",
+    "ring",
+    "grid2x4",
+    "grid4x2",
+    "hier2x4",
+    "hier4x2",
+    "grid2x2x2",
+    "hier2x2x2",
+    "nofuse",
+)
+
+TRAIN_MODEL = {
+    "hidden_size": 256,
+    "num_layers": 4,
+    "num_heads": 8,
+    "ffn_intermediate": 1024,
+    "attention": "full",
+    "dtype": "float32",
+}
+
+NOFUSE_OPTIONS = {
+    "xla_disable_hlo_passes":
+        "all-reduce-combiner,all-gather-combiner,reduce-scatter-combiner",
+}
+
+
+def log(msg: str) -> None:
+    print(f"[publish {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def stage_1d() -> None:
+    log("1D canonical grid (+ extended sizes)")
+    out = RESULTS / "1d" / "xla_tpu"
+    ext_sizes = tuple(
+        (k, v) for k, v in EXTENDED_DATA_SIZES_1D.items()
+        if k not in DATA_SIZES_1D
+    )
+    run_sweep(Sweep1D(
+        output_dir=str(out),
+        max_config_seconds=20.0,
+        max_global_bytes=24 * GIB,
+    ))
+    # extended sizes: fewer rank counts, tighter budget — the big-payload
+    # tail of the north-star 1KB..1GB curve
+    run_sweep(Sweep1D(
+        data_sizes=ext_sizes,
+        rank_counts=(4, 8),
+        output_dir=str(out),
+        max_config_seconds=15.0,
+        max_global_bytes=24 * GIB,
+    ))
+
+
+def stage_3d() -> None:
+    log("3D reference grid")
+    run_sweep(Sweep3D(
+        output_dir=str(RESULTS / "3d" / "xla_tpu"),
+        max_config_seconds=12.0,
+        max_global_bytes=40 * GIB,
+    ))
+
+
+def stage_variants() -> None:
+    log("allreduce variant matrix")
+    for name in EXECUTABLE_VARIANTS:
+        log(f"  variant {name}")
+        run_sweep(Sweep1D(
+            variant=name,
+            operations=("allreduce",),
+            output_dir=str(RESULTS / "variants" / _impl(name)),
+            max_config_seconds=20.0,
+            max_global_bytes=24 * GIB,
+        ))
+
+
+def _impl(variant: str) -> str:
+    return "xla_tpu" if variant == "default" else f"xla_tpu_{variant}"
+
+
+def stage_train() -> None:
+    from dlbb_tpu.train.loop import run_train
+
+    out = RESULTS / "train"
+    for stage in (0, 1, 2, 3):
+        for fusion in (True, False) if stage in (0, 3) else ((True,)):
+            execution = {"warmup_iterations": 2, "benchmark_iterations": 10}
+            suffix = "fused"
+            if not fusion:
+                execution["compiler_options"] = dict(NOFUSE_OPTIONS)
+                suffix = "nofuse"
+            name = f"zero{stage}_dp8_{suffix}"
+            log(f"  train {name}")
+            config = {
+                "experiment": {"name": name},
+                "model": dict(TRAIN_MODEL),
+                "parallelism": {"world_size": 1, "data_parallel": 8},
+                "input": {"batch_size": 16, "sequence_length": 64,
+                          "seed": 42},
+                "execution": execution,
+                "training": {"learning_rate": 1e-3},
+            }
+            run_train(config, zero_stage=stage, output_dir=str(out))
+
+
+def stage_stats() -> None:
+    from dlbb_tpu.stats import process_1d_results, process_3d_results
+
+    log("stats: 1d")
+    process_1d_results(RESULTS / "1d" / "xla_tpu", STATS / "1d" / "xla_tpu",
+                       verbose=False)
+    log("stats: 3d")
+    process_3d_results(RESULTS / "3d" / "xla_tpu", STATS / "3d" / "xla_tpu",
+                       implementation="xla_tpu", verbose=False)
+    log("stats: variants")
+    for name in EXECUTABLE_VARIANTS:
+        impl = _impl(name)
+        in_dir = RESULTS / "variants" / impl
+        if in_dir.exists():
+            process_1d_results(in_dir, STATS / "variants" / impl,
+                               verbose=False)
+
+
+def stage_baseline() -> None:
+    """Fill BASELINE.json's ``published`` section from the committed stats."""
+    import csv
+
+    baseline_path = REPO / "BASELINE.json"
+    data = json.loads(baseline_path.read_text())
+    published: dict = {
+        "host": "single-core CPU, 8 simulated XLA devices "
+                "(xla_force_host_platform_device_count)",
+        "note": "collective numbers are host-RAM bandwidth, not ICI; the "
+                "TPU-chip numbers live in results/e2e + BENCH_r*.json",
+        "artifacts": {
+            "results_1d": (sorted(
+                str(p.relative_to(REPO))
+                for p in (RESULTS / "1d").rglob("*.json"))[:3] + ["..."]
+                if (RESULTS / "1d").exists() else []),
+            "stats_1d_csv": "stats/1d/xla_tpu/benchmark_statistics.csv",
+            "stats_3d_dir": "stats/3d/xla_tpu/",
+            "variants": sorted(
+                p.name for p in (STATS / "variants").iterdir()
+                if p.is_dir()) if (STATS / "variants").exists() else [],
+        },
+    }
+    csv_path = STATS / "1d" / "xla_tpu" / "benchmark_statistics.csv"
+    if csv_path.exists():
+        with csv_path.open() as f:
+            rows = list(csv.DictReader(f))
+        pick = [r for r in rows
+                if r.get("operation") == "allreduce"
+                and r.get("data_size_name") == "16MB"]
+        published["allreduce_16MB"] = [
+            {k: r.get(k) for k in
+             ("num_ranks", "mean_time_us", "bandwidth_gbps")}
+            for r in pick
+        ]
+    train_dir = RESULTS / "train"
+    if train_dir.exists():
+        ladder = {}
+        for p in sorted(train_dir.glob("train_*.json")):
+            r = json.loads(p.read_text())
+            ladder[r["experiment"]["name"]] = {
+                "step_time_mean_s": r["step_time"]["mean"],
+                "tokens_per_second": r["tokens_per_second"],
+                "achieved_tflops_per_second":
+                    r["achieved_tflops_per_second"],
+            }
+        published["train_zero_ladder"] = ladder
+    data["published"] = published
+    baseline_path.write_text(json.dumps(data, indent=2) + "\n")
+    log("BASELINE.json published section updated")
+
+
+STAGES = {
+    "1d": stage_1d,
+    "3d": stage_3d,
+    "variants": stage_variants,
+    "train": stage_train,
+    "stats": stage_stats,
+    "baseline": stage_baseline,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--stage", default="all",
+                    choices=["all", *STAGES])
+    args = ap.parse_args()
+    t0 = time.time()
+    names = list(STAGES) if args.stage == "all" else [args.stage]
+    for name in names:
+        t = time.time()
+        STAGES[name]()
+        log(f"stage {name} done in {time.time() - t:.0f}s")
+    log(f"all done in {time.time() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,50 @@ def test_sweep_1d_hierarchical_variant(tmp_path, devices):
     assert data["mesh_shape"] == [2, 2, 2]
 
 
+def test_sweep_1d_time_budget_clamps_iterations(tmp_path, devices):
+    """max_config_seconds scales iteration counts down and records the
+    actual counts — artifacts never overstate the sample size."""
+    sweep = _tiny_1d(
+        tmp_path, operations=("allreduce",), data_sizes=(("1MB", 262144),),
+        rank_counts=(8,), measurement_iterations=10_000,
+        max_config_seconds=0.05,
+    )
+    files = run_sweep(sweep, verbose=False)
+    assert len(files) == 1
+    data = json.loads(files[0].read_text())
+    assert data["time_budget_clamped"] is True
+    assert data["measurement_iterations"] < 10_000
+    assert data["measurement_iterations"] == len(data["timings"][0])
+    assert data["time_budget_s"] == 0.05
+
+
+def test_sweep_1d_nofuse_variant(tmp_path, devices):
+    """The fusion-off variant (combiner HLO passes disabled via
+    per-computation compiler options) executes and is labeled."""
+    sweep = _tiny_1d(
+        tmp_path, variant="nofuse", operations=("allreduce",),
+        data_sizes=(("1KB", 256),), rank_counts=(8,),
+    )
+    files = run_sweep(sweep, verbose=False)
+    assert len(files) == 1
+    data = json.loads(files[0].read_text())
+    assert data["implementation"] == "xla_test_nofuse"
+
+
+def test_variant_axis_order_meshes():
+    """grid/hier axis-order variants resolve to transposed meshes; ring
+    fallback covers other rank counts."""
+    from dlbb_tpu.comm.variants import get_variant
+
+    assert get_variant("grid2x4").mesh_spec(8).shape == (2, 4)
+    assert get_variant("grid4x2").mesh_spec(8).shape == (4, 2)
+    assert get_variant("hier2x4").hierarchical
+    import pytest
+
+    with pytest.raises(ValueError):
+        get_variant("grid4x2").mesh_spec(4)
+
+
 def test_stats_1d_pipeline(tmp_path, devices):
     run_sweep(_tiny_1d(tmp_path), verbose=False)
     results = process_1d_results(

@@ -48,6 +48,31 @@ def test_loss_decreases(devices, zero1):
     assert result["final_step"] == 7  # warmup 1 + 6 measured
 
 
+def test_train_utilisation_metrics(devices):
+    """run_train reports tokens/s + achieved TFLOP/s with the 3x-forward +
+    optimizer-update FLOPs accounting, so ZeRO-stage overheads compare as
+    utilisation (parity depth with reference run_mpi.py:217-225)."""
+    from dlbb_tpu.models.transformer import forward_flops
+    from dlbb_tpu.train.loop import OPTIMIZER_FLOPS_PER_PARAM
+
+    result = run_train(_config(), verbose=False)
+    tokens = 8 * 16
+    mean = result["step_time"]["mean"]
+    np.testing.assert_allclose(
+        result["tokens_per_second"], tokens / mean, rtol=1e-6
+    )
+    fwd = forward_flops(TINY, 8, 16)
+    assert result["forward_flops"] == fwd
+    assert result["model_flops_per_step"] == (
+        3 * fwd + OPTIMIZER_FLOPS_PER_PARAM["adam"] * result["num_params"]
+    )
+    np.testing.assert_allclose(
+        result["achieved_tflops_per_second"],
+        result["model_flops_per_step"] / mean / 1e12, rtol=1e-6,
+    )
+    assert result["num_params"] > 0
+
+
 def test_zero1_shards_optimizer_state(devices):
     """ZeRO-1: Adam mu/nu must actually be sharded over dp, DDP must not."""
     mesh = build_mesh(MeshSpec.grid((4, 2), ("dp", "tp")))
