@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """Headline benchmark — prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}`` (single-chip
+runs add an ``"extras"`` key with secondary 7B / full- and flash-attention
+lines; the four headline keys are always present).
 
 Two regimes, chosen by available device count:
 
@@ -86,28 +88,55 @@ def _cpu_baseline() -> dict:
     return result
 
 
-def bench_e2e_single_chip() -> dict:
+def _e2e(size: str, attention: str, iters: int = 10) -> dict:
     from dlbb_tpu.bench.e2e import run_e2e
 
     config = {
-        "experiment": {"name": "bench_1b_world1"},
-        "model": {"size": "1B", "attention": "simplified"},
+        "experiment": {"name": f"bench_{size.lower()}_{attention}_world1"},
+        "model": {"size": size, "attention": attention},
         "parallelism": {"world_size": 1, "data_parallel": 1},
         "input": {"batch_size": E2E_BATCH, "sequence_length": E2E_SEQ,
                   "seed": 42},
-        "execution": {"warmup_iterations": 3, "benchmark_iterations": 10},
+        "execution": {"warmup_iterations": 3, "benchmark_iterations": iters},
     }
     result = run_e2e(config, verbose=False)
+    log(f"TPU {size}/{attention} forward: "
+        f"{result['forward_time']['mean'] * 1e3:.2f} ms, "
+        f"{result['tokens_per_second']:.0f} tok/s, "
+        f"{result['achieved_tflops_per_second']:.1f} TFLOP/s "
+        f"({result.get('timing_mode')})")
+    return result
+
+
+def bench_e2e_single_chip() -> dict:
+    result = _e2e("1B", "simplified")
     tps = result["tokens_per_second"]
-    log(f"TPU 1B forward: {result['forward_time']['mean'] * 1e3:.2f} ms, "
-        f"{tps:.0f} tok/s ({result.get('timing_mode')})")
     baseline = _cpu_baseline()
-    return {
+    out = {
         "metric": "e2e_1B_forward_throughput_vs_reference_cpu_stack",
         "value": round(tps, 1),
         "unit": "tokens/s",
         "vs_baseline": round(tps / baseline["tokens_per_second"], 3),
     }
+    # secondary lines (VERDICT r1 #3): the flagship 7B config and the
+    # real-attention 1B paths, reported alongside the headline
+    extras = {}
+    for size, attention in (("7B", "simplified"), ("7B", "full"),
+                            ("1B", "full"), ("1B", "flash")):
+        try:
+            r = _e2e(size, attention, iters=10)
+            extras[f"{size}_{attention}"] = {
+                "tokens_per_second": round(r["tokens_per_second"], 1),
+                "achieved_tflops_per_second":
+                    round(r["achieved_tflops_per_second"], 2),
+                "forward_mean_ms":
+                    round(r["forward_time"]["mean"] * 1e3, 3),
+            }
+        except Exception as e:  # noqa: BLE001 — extras never kill the headline
+            log(f"extra bench {size}/{attention} failed: {e}")
+    if extras:
+        out["extras"] = extras
+    return out
 
 
 def main() -> int:

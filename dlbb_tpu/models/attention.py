@@ -1,8 +1,9 @@
-"""Dense causal attention — the single shared kernel.
+"""Dense attention — the single shared kernel.
 
 Used by the model's "full" mode and as the per-head-group kernel inside
 Ulysses sequence parallelism.  fp32 softmax and PV accumulation, cast back
-to the input dtype at the end.
+to the input dtype at the end.  Causal (decoder) masking is the default;
+``causal=False`` gives bidirectional attention.
 """
 
 from __future__ import annotations
@@ -13,18 +14,37 @@ import jax
 import jax.numpy as jnp
 
 
-def dense_causal(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """q, k, v: ``[B, num_heads, S, head_dim]`` -> same shape."""
-    d = q.shape[-1]
-    logits = (
-        jnp.einsum(
-            "bnqd,bnkd->bnqk", q.astype(jnp.float32), k.astype(jnp.float32)
-        )
-        / math.sqrt(d)
-    )
-    s = q.shape[2]
-    mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-    logits = jnp.where(mask, logits, -jnp.inf)
+def dense_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = True
+) -> jax.Array:
+    """q: ``[B, num_heads, S, head_dim]`` -> same shape.
+
+    k, v: ``[B, num_heads, S, head_dim]``, or grouped-query
+    ``[B, kv_heads, S, head_dim]`` with ``num_heads % kv_heads == 0`` —
+    query-head groups then share K/V heads via einsum broadcasting, with no
+    materialised repeat (K/V stay at kv_heads width in memory).
+    """
+    b, n, s, d = q.shape
+    kvh = k.shape[1]
+    q32, k32, v32 = (t.astype(jnp.float32) for t in (q, k, v))
+    grouped = kvh != n
+    if grouped:
+        q32 = q32.reshape(b, kvh, n // kvh, s, d)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", q32, k32) / math.sqrt(d)
+    else:
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q32, k32) / math.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        logits = jnp.where(mask, logits, -jnp.inf)
     probs = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bnqk,bnkd->bnqd", probs, v.astype(jnp.float32))
+    if grouped:
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v32)
+        out = out.reshape(b, n, s, d)
+    else:
+        out = jnp.einsum("bnqk,bnkd->bnqd", probs, v32)
     return out.astype(q.dtype)
+
+
+def dense_causal(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """Causal ``dense_attention`` (back-compat name)."""
+    return dense_attention(q, k, v, causal=True)

@@ -23,6 +23,17 @@ class ModelConfig:
     # attention — dlbb_tpu.parallel)
     attention: str = "full"
     dtype: str = "bfloat16"
+    # Grouped-query attention: number of K/V heads (None = num_heads, i.e.
+    # full MHA; 1 = MQA).  Query heads share K/V heads in groups of
+    # num_heads // num_kv_heads.  The projection/params shrink in every
+    # mode; K/V activations additionally stay at kv_heads width through the
+    # dense "full" kernel (flash/ring/ulysses broadcast K/V to num_heads
+    # before their kernels — see transformer._attention).
+    num_kv_heads: int | None = None
+    # Causal (decoder) masking; False = bidirectional attention.  The
+    # "simplified" reference shortcut has no attention at all and ignores
+    # this; ring attention is causal-only (its skew-schedule assumes it).
+    causal: bool = True
     # Mixture-of-experts FFN (0 = dense FFN).  num_experts > 0 replaces each
     # block's FFN with moe_top_k-gated experts; experts shard over an
     # ``ep`` mesh axis (capability extension — the reference has no EP,
@@ -71,10 +82,38 @@ class ModelConfig:
                 f"moe_capacity_factor must be > 0, got "
                 f"{self.moe_capacity_factor}"
             )
+        if self.num_kv_heads is not None:
+            if not 1 <= self.num_kv_heads <= self.num_heads:
+                raise ValueError(
+                    f"num_kv_heads={self.num_kv_heads} must be in "
+                    f"[1, num_heads={self.num_heads}]"
+                )
+            if self.num_heads % self.num_kv_heads != 0:
+                raise ValueError(
+                    f"num_heads={self.num_heads} not divisible by "
+                    f"num_kv_heads={self.num_kv_heads}"
+                )
+        if not self.causal and self.attention == "ring":
+            raise ValueError(
+                "attention='ring' is causal-only (the ring schedule skews "
+                "by rank assuming a causal mask); use 'ulysses', 'full', "
+                "or 'flash' for bidirectional attention"
+            )
 
     @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
+
+    @property
+    def kv_heads(self) -> int:
+        """Effective K/V head count (GQA; == num_heads for full MHA)."""
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def qkv_width(self) -> int:
+        """Fused QKV projection output width:
+        H (queries) + 2 * kv_heads * head_dim (keys + values)."""
+        return self.hidden_size + 2 * self.kv_heads * self.head_dim
 
     @property
     def is_moe(self) -> bool:
@@ -95,7 +134,8 @@ class ModelConfig:
         fields = {}
         for k in (
             "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
-            "attention", "dtype", "num_experts", "moe_top_k",
+            "attention", "dtype", "num_kv_heads", "causal",
+            "num_experts", "moe_top_k",
             "moe_dispatch", "moe_capacity_factor", "remat",
         ):
             if k in d:

@@ -82,8 +82,10 @@ def init_params(config: ModelConfig, key: jax.Array) -> Params:
     layers = {
         "ln1": {"scale": jnp.ones((L, h), dtype), "bias": jnp.zeros((L, h), dtype)},
         "qkv": {
-            "kernel": kernel(ks[0], (L, h, 3 * h), h),
-            "bias": jnp.zeros((L, 3 * h), dtype),
+            # qkv_width = H + 2 * kv_heads * head_dim (GQA shrinks the
+            # K/V thirds; == 3H for full MHA)
+            "kernel": kernel(ks[0], (L, h, config.qkv_width), h),
+            "bias": jnp.zeros((L, config.qkv_width), dtype),
         },
         "out": {
             "kernel": kernel(ks[1], (L, h, h), h),
@@ -107,20 +109,30 @@ def _layernorm(x, scale, bias):
 
 
 def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
-    """qkv: [B, S, 3H] -> [B, S, H]."""
+    """qkv: [B, S, qkv_width] -> [B, S, H]."""
     if config.attention == "simplified":
         # reference's benchmarking shortcut: the query projection IS the
         # attention output (``models.py:162-167``)
         return qkv[:, :, : config.hidden_size]
 
     b, s, _ = qkv.shape
-    n, d = config.num_heads, config.head_dim
-    q, k, v = jnp.split(qkv, 3, axis=-1)
+    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+    h = config.hidden_size
+    q = qkv[:, :, :h]
+    k = qkv[:, :, h:h + kvh * d]
+    v = qkv[:, :, h + kvh * d:]
 
-    def heads(t):  # [B, S, H] -> [B, n, S, d]
-        return t.reshape(b, s, n, d).transpose(0, 2, 1, 3)
+    def heads(t, nh):  # [B, S, nh*d] -> [B, nh, S, d]
+        return t.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
 
-    q, k, v = heads(q), heads(k), heads(v)
+    q, k, v = heads(q, n), heads(k, kvh), heads(v, kvh)
+    if kvh != n and config.attention != "full":
+        # flash/ring/ulysses consume plain MHA shapes, so K/V are broadcast
+        # to num_heads before those kernels: their GQA saving is currently
+        # the projection width only.  The dense "full" path keeps K/V at
+        # kv_heads width end-to-end (grouped einsum in dense_attention).
+        k = jnp.repeat(k, n // kvh, axis=1)
+        v = jnp.repeat(v, n // kvh, axis=1)
 
     if config.attention in ("ring", "ulysses"):
         # sequence/context-parallel attention over the mesh's sp axis
@@ -131,8 +143,11 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
             )
         from dlbb_tpu.parallel import ring_attention, ulysses_attention
 
-        attn = ring_attention if config.attention == "ring" else ulysses_attention
-        o = attn(q, k, v, mesh, sp_axis=sp_axis)
+        if config.attention == "ring":
+            o = ring_attention(q, k, v, mesh, sp_axis=sp_axis)  # causal-only
+        else:
+            o = ulysses_attention(q, k, v, mesh, sp_axis=sp_axis,
+                                  causal=config.causal)
     elif config.attention == "flash":
         from dlbb_tpu.ops import flash_attention
 
@@ -161,16 +176,17 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
 
             spec = P(dp, tp, None, None)
             o = shard_map(
-                lambda q, k, v: flash_attention(q, k, v, causal=True),
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal=config.causal),
                 mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
                 check_vma=False,  # pallas_call declares no vma
             )(q, k, v)
         else:
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=config.causal)
     else:
-        from dlbb_tpu.models.attention import dense_causal
+        from dlbb_tpu.models.attention import dense_attention
 
-        o = dense_causal(q, k, v)
+        o = dense_attention(q, k, v, causal=config.causal)
     return o.transpose(0, 2, 1, 3).reshape(b, s, n * d)
 
 
@@ -361,9 +377,10 @@ def num_parameters(config: ModelConfig) -> int:
         ffn = h * E + E * (h * f + f) + E * (f * h + h)  # router + experts
     else:
         ffn = (h * f + f) + (f * h + h)
+    qkvw = config.qkv_width
     per_layer = (
         2 * h            # ln1
-        + h * 3 * h + 3 * h  # qkv
+        + h * qkvw + qkvw  # fused qkv (GQA-aware width)
         + h * h + h      # out
         + 2 * h          # ln2
         + ffn
@@ -378,7 +395,7 @@ def forward_flops(config: ModelConfig, batch_size: int, seq_len: int) -> int:
     achieved-TFLOP/s reporting in the harnesses."""
     h, f, L = config.hidden_size, config.ffn_intermediate, config.num_layers
     tokens = batch_size * seq_len
-    qkv = 2 * tokens * h * 3 * h
+    qkv = 2 * tokens * h * config.qkv_width
     out = 2 * tokens * h * h
     if config.attention == "simplified":
         attn = 0  # the reference's shortcut has no attention matmuls

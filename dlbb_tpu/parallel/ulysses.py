@@ -17,7 +17,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from dlbb_tpu.models.attention import dense_causal as _dense_causal
+from dlbb_tpu.models.attention import dense_attention as _dense_attention
 
 
 def ulysses_attention(
@@ -29,11 +29,10 @@ def ulysses_attention(
     causal: bool = True,
     batch_axes: Sequence[str] = ("dp",),
 ) -> jax.Array:
-    """Exact attention with sequence sharded over ``sp_axis`` via head
-    resharding.  q, k, v: global ``[B, num_heads, S, head_dim]``;
-    ``num_heads`` must be divisible by the ``sp_axis`` mesh size."""
-    if not causal:
-        raise NotImplementedError("ulysses_attention is causal-only for now")
+    """Exact attention (causal or bidirectional) with sequence sharded over
+    ``sp_axis`` via head resharding.  q, k, v: global
+    ``[B, num_heads, S, head_dim]``; ``num_heads`` must be divisible by the
+    ``sp_axis`` mesh size."""
     if sp_axis not in mesh.axis_names:
         raise ValueError(
             f"mesh {mesh.axis_names} has no {sp_axis!r} axis for ulysses"
@@ -53,7 +52,7 @@ def ulysses_attention(
         qh = lax.all_to_all(q_, sp_axis, split_axis=1, concat_axis=2, tiled=True)
         kh = lax.all_to_all(k_, sp_axis, split_axis=1, concat_axis=2, tiled=True)
         vh = lax.all_to_all(v_, sp_axis, split_axis=1, concat_axis=2, tiled=True)
-        oh = _dense_causal(qh, kh, vh)  # [B, n/P, S, d]
+        oh = _dense_attention(qh, kh, vh, causal=causal)  # [B, n/P, S, d]
         # head-sharded -> seq-sharded
         return lax.all_to_all(oh, sp_axis, split_axis=2, concat_axis=1, tiled=True)
 

@@ -48,6 +48,22 @@ def test_matches_dense_causal(sp_mesh, attn, devices):
     np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
 
 
+def test_ulysses_non_causal_matches_dense(sp_mesh, devices):
+    """Bidirectional Ulysses == dense non-causal attention (the causal=False
+    path added for the long-context configs)."""
+    q, k, v = _qkv()
+    qn, kn, vn = (np.asarray(t, np.float64) for t in (q, k, v))
+    logits = np.einsum("bnqd,bnkd->bnqk", qn, kn) / np.sqrt(D)
+    logits -= logits.max(-1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(-1, keepdims=True)
+    expected = np.einsum("bnqk,bnkd->bnqd", p, vn)
+    sharding = NamedSharding(sp_mesh, P("dp", None, "sp", None))
+    qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+    out = np.asarray(ulysses_attention(qs, ks, vs, sp_mesh, causal=False))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
 def test_ring_attention_jits_inside_jit(sp_mesh, devices):
     q, k, v = _qkv()
     sharding = NamedSharding(sp_mesh, P("dp", None, "sp", None))

@@ -42,6 +42,84 @@ def test_forward_shapes_and_dtype():
     assert np.isfinite(np.asarray(y)).all()
 
 
+def test_gqa_forward_and_param_accounting():
+    """Grouped-query attention: smaller QKV projection, same output shape;
+    num_parameters matches the actual pytree; MQA (kv=1) included."""
+    for kv in (2, 1):
+        cfg = TINY.with_(num_kv_heads=kv)
+        assert cfg.qkv_width == cfg.hidden_size + 2 * kv * cfg.head_dim
+        params = init_params(cfg, jax.random.key(1))
+        qkv_kernel = params["layers"]["qkv"]["kernel"]
+        assert qkv_kernel.shape == (
+            cfg.num_layers, cfg.hidden_size, cfg.qkv_width
+        )
+        counted = sum(int(x.size) for x in jax.tree.leaves(params))
+        assert counted == num_parameters(cfg)
+        y = forward(params, _batch(cfg), cfg)
+        assert y.shape == (2, 16, cfg.hidden_size)
+        assert np.isfinite(np.asarray(y)).all()
+
+
+def test_grouped_dense_attention_matches_repeat():
+    """dense_attention with kv_heads-width K/V == MHA over repeated K/V —
+    the no-materialised-repeat GQA path is numerically identical."""
+    from dlbb_tpu.models.attention import dense_attention
+
+    q = jax.random.normal(jax.random.key(0), (2, 8, 16, 4))
+    k = jax.random.normal(jax.random.key(1), (2, 2, 16, 4))
+    v = jax.random.normal(jax.random.key(2), (2, 2, 16, 4))
+    for causal in (True, False):
+        got = np.asarray(dense_attention(q, k, v, causal=causal))
+        want = np.asarray(dense_attention(
+            q, jnp.repeat(k, 4, axis=1), jnp.repeat(v, 4, axis=1),
+            causal=causal,
+        ))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_gqa_full_group_matches_mha():
+    """num_kv_heads == num_heads is exactly MHA — same params, same output."""
+    cfg_mha = TINY
+    cfg_gqa = TINY.with_(num_kv_heads=TINY.num_heads)
+    params = init_params(cfg_mha, jax.random.key(1))
+    x = _batch(cfg_mha)
+    np.testing.assert_array_equal(
+        np.asarray(forward(params, x, cfg_mha)),
+        np.asarray(forward(params, x, cfg_gqa)),
+    )
+
+
+def test_non_causal_attention():
+    """causal=False: bidirectional dense attention — output differs from
+    causal, matches a manual fp32 softmax reference, and the dense/ulysses
+    kernels agree (ulysses covered in test_context_parallel)."""
+    from dlbb_tpu.models.attention import dense_attention
+
+    cfg = TINY.with_(causal=False)
+    params = init_params(cfg, jax.random.key(1))
+    x = _batch(cfg)
+    y_bi = np.asarray(forward(params, x, cfg))
+    y_causal = np.asarray(forward(params, x, TINY))
+    assert np.isfinite(y_bi).all()
+    assert not np.allclose(y_bi, y_causal)
+
+    q = jax.random.normal(jax.random.key(3), (2, 4, 8, 16))
+    k = jax.random.normal(jax.random.key(4), (2, 4, 8, 16))
+    v = jax.random.normal(jax.random.key(5), (2, 4, 8, 16))
+    got = np.asarray(dense_attention(q, k, v, causal=False))
+    logits = np.einsum("bnqd,bnkd->bnqk", np.asarray(q), np.asarray(k))
+    logits /= np.sqrt(16)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.einsum("bnqk,bnkd->bnqd", probs, np.asarray(v))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_ring_non_causal_rejected():
+    with pytest.raises(ValueError, match="causal-only"):
+        TINY.with_(attention="ring", causal=False)
+
+
 @pytest.mark.parametrize("attention", ["full", "simplified", "flash"])
 def test_tp_matches_single_device(mesh2x4, attention):
     """Sharded == unsharded, across attention modes (flash exercises the
