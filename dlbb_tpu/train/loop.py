@@ -198,7 +198,11 @@ def make_train_step(
     ``moe_aux_weight`` adds the MoE load-balancing loss; ``grad_accum``
     splits the batch into that many sequential micro-steps whose mean
     gradient feeds one optimizer update (same numerics as the full batch
-    for mean losses, 1/grad_accum the activation memory)."""
+    for mean losses, 1/grad_accum the activation memory).
+
+    The returned step donates its state argument, and the ``device_put``
+    here may alias the caller's ``params`` buffers — treat the input
+    ``params`` pytree as consumed once the first step has run."""
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     stage = resolve_zero_stage(zero1, zero_stage)

@@ -57,14 +57,65 @@ def resolve_timing_mode(mode: str = "auto") -> str:
 
 
 def force_completion(x: Any) -> float:
-    """Force completion of ``x`` via a minimal data-dependent fetch (a scalar
-    derived from the result must cross the wire, so enqueue cannot satisfy
-    it)."""
+    """Force completion of ``x`` via a minimal data-dependent fetch: a
+    device-side reduction to one scalar, then fetch.  The reduction depends
+    on EVERY shard of a sharded result (a single-element slice would only
+    force shard 0's producer), while only a scalar crosses the wire (a
+    ``ravel()[0]`` fetch would all-gather the whole payload first).  The
+    reduction's own device cost appears identically in
+    ``calibrate_fetch_overhead`` and is subtracted by the chained-timing
+    math; the value itself is irrelevant (NaN/inf are fine)."""
     leaf = jax.tree.leaves(x)[0]
-    return float(jnp.asarray(leaf).ravel()[0])
+    return float(jnp.sum(leaf))
 
 
 _force = force_completion
+
+
+def single_iteration_estimate(
+    fn, x, trials: int = 3, op_args: tuple = (), agg: str = "median"
+) -> float:
+    """True-completion time of one ``fn(*op_args, x)`` call: wall time of a
+    data-dependent scalar fetch on the result, minus the calibrated fetch
+    overhead.  Works on any backend — the fetch cannot be satisfied by
+    enqueue — so it cross-validates both timing modes (at one-dispatch
+    granularity; see scripts/timing_crosscheck.py).
+
+    ``agg``: "median" for a central estimate (cross-check artifacts), "min"
+    for a stall-robust lower bound (the plausibility check — on a loaded
+    host any single trial can absorb a multi-ms scheduler stall, and an
+    inflated estimate there would falsely condemn honest per-iter
+    timings)."""
+    out = fn(*op_args, x)
+    _force(out)  # compile + warm
+    overhead = calibrate_fetch_overhead(out)
+    samples = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        _force(fn(*op_args, x))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    pick = samples[0] if agg == "min" else samples[len(samples) // 2]
+    return max(pick - overhead, 0.0)
+
+
+def per_iter_plausible(median_block: float, forced: float,
+                       ratio: float = 0.2, floor: float = 0.02) -> bool:
+    """Is a ``block_until_ready``-based median believable against the
+    forced-completion time of one iteration?  Implausible = the op
+    "finishes" in under ``ratio`` of its true completion time while the
+    true time is above ``floor`` — the signature of a backend whose
+    block_until_ready returns on enqueue (remote-async), where per-iter
+    timings would be dispatch latencies, not device times.
+
+    ``floor`` is 20 ms: below that, eager-dispatch overhead on a loaded
+    host is the same magnitude as the probe itself (no reliable signal),
+    and sub-floor ops are dispatch-dominated on a remote backend anyway —
+    the regime where dishonest per-iter numbers distort published results
+    is the one this check covers."""
+    if forced < floor:
+        return True  # too fast to distinguish dispatch from completion
+    return median_block >= ratio * forced
 
 
 def calibrate_fetch_overhead(x: Any, trials: int = 5) -> float:
@@ -184,6 +235,12 @@ def time_fn_chained(
             "fetch overhead subtracted (remote-async backend)"
         ),
         "timing_granularity": f"chunked({chunk_size})",
+        # each sample is a chunk MEAN: downstream p95/p99 measure the
+        # spread of chunk means, not per-iteration tail latencies
+        "percentile_caveat": (
+            f"percentiles are over {chunk_size}-iteration chunk means, "
+            "not per-iteration tails"
+        ),
         "chunks": chunks,
         "chunk_size": chunk_size,
         "fetch_overhead_s": overhead,
@@ -217,17 +274,61 @@ def time_collective(
     """
     mode = resolve_timing_mode(mode)
     if mode == "per_iter":
+        op_exec = op
         if compiler_options and hasattr(op, "lower"):
-            op = op.lower(x).compile(compiler_options=dict(compiler_options))
+            # keep the traceable `op` around: the chained fallback below
+            # jit-traces it, which a Compiled cannot survive
+            op_exec = op.lower(x).compile(
+                compiler_options=dict(compiler_options)
+            )
         timings, warmup_run, clamped = time_fn_per_iter(
-            op, x, warmup=warmup, iterations=iterations,
+            op_exec, x, warmup=warmup, iterations=iterations,
             max_seconds=max_seconds,
         )
+        # Plausibility floor (robustness beyond the env-marker detection in
+        # resolve_timing_mode): if block_until_ready "finished" in a small
+        # fraction of the true data-dependent completion time, this backend
+        # is remote-async and per-iter numbers are dispatch latencies —
+        # warn and fall back to honest chained timing.  Dispatch latencies
+        # are ms-scale even over a tunnel, so a >= 50 ms median cannot be
+        # enqueue-only and the probe is skipped (saves iterations on huge
+        # budgeted configs; recorded as skipped, not as a fake validation).
         meta = {
             "timing_mode": "per_iter",
             "timing_method": "time.perf_counter() + jax.block_until_ready()",
             "timing_granularity": "per_iteration",
         }
+        if not timings:  # iterations=0: nothing to sanity-check
+            return timings, meta
+        sorted_t = sorted(timings)
+        median = sorted_t[len(sorted_t) // 2]
+        if median >= 0.05:
+            meta["forced_completion_probe_skipped"] = True
+        else:
+            forced = single_iteration_estimate(op_exec, x, trials=3,
+                                               agg="min")
+            if not per_iter_plausible(median, forced):
+                import warnings
+
+                warnings.warn(
+                    f"per-iteration timing implausible (median "
+                    f"{median * 1e3:.3f} ms vs forced completion "
+                    f"{forced * 1e3:.3f} ms): block_until_ready appears to "
+                    "return on enqueue; switching to chained timing",
+                    stacklevel=2,
+                )
+                samples, cmeta = time_fn_chained(
+                    op, x, chain=chain, warmup=1, iterations=iterations,
+                    compiler_options=compiler_options,
+                    max_seconds=max_seconds,
+                )
+                cmeta.update(
+                    per_iter_sanity_failed=True,
+                    per_iter_median_s=median,
+                    forced_completion_s=forced,
+                )
+                return samples, cmeta
+            meta["forced_completion_s"] = forced
         if clamped:
             meta.update(
                 measurement_iterations=len(timings),

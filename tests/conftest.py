@@ -17,6 +17,23 @@ import pytest  # noqa: E402
 from dlbb_tpu.comm import MeshSpec, build_mesh  # noqa: E402
 
 
+def dense_attention_ref(q, k, v, causal=True):
+    """fp64 numpy oracle for dense (optionally causal) attention — the one
+    numerical reference shared by the model/context-parallel tests."""
+    import numpy as np
+
+    q, k, v = (np.asarray(t, np.float64) for t in (q, k, v))
+    logits = np.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(q.shape[-1])
+    if causal:
+        s = q.shape[2]
+        mask = np.tril(np.ones((s, s), dtype=bool))
+        logits = np.where(mask, logits, -np.inf)
+    logits = logits - logits.max(-1, keepdims=True)
+    p = np.exp(logits)
+    p /= p.sum(-1, keepdims=True)
+    return np.einsum("bnqk,bnkd->bnqd", p, v)
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()

@@ -28,14 +28,11 @@ def _qkv(seed=0, dtype=jnp.float32):
     )
 
 
+from conftest import dense_attention_ref
+
+
 def _dense_causal_ref(q, k, v):
-    logits = np.einsum("bnqd,bnkd->bnqk", q, k) / np.sqrt(q.shape[-1])
-    mask = np.tril(np.ones((q.shape[2], q.shape[2]), dtype=bool))
-    logits = np.where(mask, logits, -np.inf)
-    logits -= logits.max(-1, keepdims=True)
-    p = np.exp(logits)
-    p /= p.sum(-1, keepdims=True)
-    return np.einsum("bnqk,bnkd->bnqd", p, v)
+    return dense_attention_ref(q, k, v, causal=True)
 
 
 @pytest.mark.parametrize("attn", [ring_attention, ulysses_attention])
@@ -52,12 +49,7 @@ def test_ulysses_non_causal_matches_dense(sp_mesh, devices):
     """Bidirectional Ulysses == dense non-causal attention (the causal=False
     path added for the long-context configs)."""
     q, k, v = _qkv()
-    qn, kn, vn = (np.asarray(t, np.float64) for t in (q, k, v))
-    logits = np.einsum("bnqd,bnkd->bnqk", qn, kn) / np.sqrt(D)
-    logits -= logits.max(-1, keepdims=True)
-    p = np.exp(logits)
-    p /= p.sum(-1, keepdims=True)
-    expected = np.einsum("bnqk,bnkd->bnqd", p, vn)
+    expected = dense_attention_ref(q, k, v, causal=False)
     sharding = NamedSharding(sp_mesh, P("dp", None, "sp", None))
     qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
     out = np.asarray(ulysses_attention(qs, ks, vs, sp_mesh, causal=False))

@@ -103,15 +103,13 @@ def test_non_causal_attention():
     assert np.isfinite(y_bi).all()
     assert not np.allclose(y_bi, y_causal)
 
+    from conftest import dense_attention_ref
+
     q = jax.random.normal(jax.random.key(3), (2, 4, 8, 16))
     k = jax.random.normal(jax.random.key(4), (2, 4, 8, 16))
     v = jax.random.normal(jax.random.key(5), (2, 4, 8, 16))
     got = np.asarray(dense_attention(q, k, v, causal=False))
-    logits = np.einsum("bnqd,bnkd->bnqk", np.asarray(q), np.asarray(k))
-    logits /= np.sqrt(16)
-    probs = np.exp(logits - logits.max(-1, keepdims=True))
-    probs /= probs.sum(-1, keepdims=True)
-    want = np.einsum("bnqk,bnkd->bnqd", probs, np.asarray(v))
+    want = dense_attention_ref(q, k, v, causal=False)
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 

@@ -1,0 +1,91 @@
+"""Timing-honesty unit tests (SURVEY §7 "timing semantics under async
+dispatch"; VERDICT r1 weak #4/#5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlbb_tpu.utils.timing import (
+    per_iter_plausible,
+    resolve_timing_mode,
+    single_iteration_estimate,
+    time_collective,
+    time_fn_chained,
+)
+
+
+def test_per_iter_plausible_decision():
+    # sync backend: block time ~= forced time
+    assert per_iter_plausible(0.050, 0.055)
+    # enqueue-only block: 0.5 ms "measured" vs 100 ms true completion
+    assert not per_iter_plausible(0.0005, 0.100)
+    # below the floor: dispatch noise ~ probe — trust per-iter
+    assert per_iter_plausible(0.0001, 0.005)
+    # boundary: exactly ratio * forced passes
+    assert per_iter_plausible(0.2 * 0.100, 0.100)
+
+
+def test_single_iteration_estimate_cpu(devices):
+    """On a sync backend the forced-completion estimate matches a directly
+    measured iteration to within noise."""
+    x = jnp.ones((512, 512))
+    f = jax.jit(lambda a: a @ a)
+    est = single_iteration_estimate(f, x, trials=3)
+    assert est >= 0.0
+    import time
+
+    jax.block_until_ready(f(x))
+    t0 = time.perf_counter()
+    jax.block_until_ready(f(x))
+    direct = time.perf_counter() - t0
+    # same order of magnitude (generous: single-core box under load)
+    assert est < direct * 10 + 0.01
+
+
+def test_time_collective_cpu_sanity_passes(devices):
+    """per_iter mode on the sync CPU backend must not trip the plausibility
+    floor; the forced-completion figure is recorded."""
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: a @ a)
+    with np.errstate(all="ignore"):
+        timings, meta = time_collective(f, x, warmup=2, iterations=5)
+    assert meta["timing_mode"] == "per_iter"
+    assert "per_iter_sanity_failed" not in meta
+    assert meta["forced_completion_s"] >= 0.0
+    assert len(timings) == 5
+
+
+def test_chained_meta_has_percentile_caveat(devices):
+    """Chunked samples are chunk means — the result metadata must say so
+    (VERDICT r1 weak #4: percentiles over chunk means, not tails)."""
+    x = jnp.ones((64, 64))
+    f = jax.jit(lambda a: a @ a)
+    samples, meta = time_fn_chained(f, x, warmup=1, iterations=10,
+                                    chunk_size=5)
+    assert "chunk means" in meta["percentile_caveat"]
+    assert meta["timing_mode"] == "chained"
+    assert len(samples) == 2
+
+
+def test_chained_max_seconds_clamps_chunks(devices):
+    """The wall-time budget applies in chained mode too (review finding):
+    chunk count shrinks and the clamp is recorded."""
+    x = jnp.ones((512, 512))
+    f = jax.jit(lambda a: a @ a)
+    samples, meta = time_fn_chained(
+        f, x, warmup=1, iterations=10_000, chunk_size=10,
+        max_seconds=0.02,
+    )
+    assert meta["time_budget_clamped"] is True
+    assert meta["chunks"] == len(samples)
+    assert meta["measurement_iterations"] == meta["chunks"] * 10
+    assert meta["chunks"] < 1000
+
+
+def test_resolve_timing_mode_env(monkeypatch):
+    monkeypatch.setenv("DLBB_TIMING_MODE", "chained")
+    assert resolve_timing_mode("auto") == "chained"
+    monkeypatch.delenv("DLBB_TIMING_MODE")
+    assert resolve_timing_mode("per_iter") == "per_iter"
