@@ -41,7 +41,12 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_allreduce_multichip(n: int) -> dict:
+def bench_allreduce_multichip(
+    n: int,
+    num_elements: int = 4_194_304,  # the reference's "16MB" label
+    warmup: int = 10,
+    iterations: int = 100,
+) -> dict:
     import jax.numpy as jnp
 
     from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
@@ -49,23 +54,29 @@ def bench_allreduce_multichip(n: int) -> dict:
     from dlbb_tpu.stats.stats1d import calculate_bandwidth
     from dlbb_tpu.utils.timing import time_collective
 
-    num_elements = 4_194_304  # the reference's "16MB" label
     mesh = build_mesh(MeshSpec.ring(n))
     op = get_op("allreduce")
     x = make_payload(op, mesh, ("ranks",), num_elements, dtype=jnp.bfloat16)
     fn = op.build(mesh, ("ranks",))
     timings, meta = time_collective(
-        fn, x, chain=op.make_chain(n), warmup=10, iterations=100
+        fn, x, chain=op.make_chain(n), warmup=warmup, iterations=iterations
     )
     max_t = max(timings)
     bw = calculate_bandwidth(num_elements, "bfloat16", max_t, "allreduce", n)
-    log(f"allreduce 16MB x{n} ranks: max {max_t * 1e3:.3f} ms, {bw:.2f} GB/s "
-        f"({meta['timing_mode']})")
+    # reference's 2x-off size label ("16MB" = 4,194,304 elements = 8 MiB)
+    label = f"{num_elements * 4 / 2**20:g}MB"
+    log(f"allreduce {label} x{n} ranks: max {max_t * 1e3:.3f} ms, "
+        f"{bw:.2f} GB/s ({meta['timing_mode']})")
     return {
-        "metric": f"1d_allreduce_16MB_bus_bandwidth_{n}ranks",
+        "metric": f"1d_allreduce_{label}_bus_bandwidth_{n}ranks",
         "value": round(bw, 3),
         "unit": "GB/s",
         "vs_baseline": round(bw / ONECCL_BASELINE_GBPS, 3),
+        "timing_mode": meta["timing_mode"],
+        "timing_granularity": meta.get("timing_granularity",
+                                       "per_iteration"),
+        "num_elements": num_elements,
+        "max_time_s": max_t,
     }
 
 

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
+from dlbb_tpu.data.synthetic import create_dataset_from_config
 from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.parallel.plan import ParallelismPlan
 from dlbb_tpu.models.sharding import batch_spec
@@ -37,7 +37,7 @@ from dlbb_tpu.models.transformer import (
     num_parameters,
 )
 from dlbb_tpu.utils.config import load_config, save_json
-from dlbb_tpu.utils.metrics import summarize
+from dlbb_tpu.utils.metrics import MetricsCollector, Timer
 from dlbb_tpu.utils.profiling import annotate
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import (
@@ -56,29 +56,25 @@ def run_e2e(
 ) -> dict[str, Any]:
     """Run the benchmark described by ``config`` (schema:
     ``configs/baseline_config.yaml``; parity with ``run_mpi.py:main``)."""
-    t_init = time.perf_counter()
+    metrics = MetricsCollector()
+    with Timer() as t_init:
+        model_cfg = ModelConfig.from_dict(config["model"])
+        plan = ParallelismPlan.from_config(config, model_cfg, devices)
+        mesh, num_microbatches = plan.mesh, plan.num_microbatches
+        dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
 
-    model_cfg = ModelConfig.from_dict(config["model"])
-    plan = ParallelismPlan.from_config(config, model_cfg, devices)
-    mesh, num_microbatches = plan.mesh, plan.num_microbatches
-    dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
-
-    params = init_params_sharded(
-        model_cfg, jax.random.key(config["input"].get("seed", 42)), mesh
-    )
-    # hidden size comes from the resolved ModelConfig, not the raw YAML —
-    # a `size: "7B"` config need not spell out hidden_size
-    dataset = SyntheticEmbeddingDataset(
-        batch_size=config["input"]["batch_size"],
-        seq_length=config["input"]["sequence_length"],
-        hidden_size=model_cfg.hidden_size,
-        seed=config["input"].get("seed", 42),
-        dtype=dtype,
-        mesh=mesh,
-        spec=batch_spec(mesh),
-    )
-    batch = dataset.get_batch()
-    init_time = time.perf_counter() - t_init
+        params = init_params_sharded(
+            model_cfg, jax.random.key(config["input"].get("seed", 42)), mesh
+        )
+        # hidden size comes from the resolved ModelConfig, not the raw YAML —
+        # a `size: "7B"` config need not spell out hidden_size
+        dataset = create_dataset_from_config(
+            config, mesh=mesh, spec=batch_spec(mesh), dtype=dtype,
+            hidden_size=model_cfg.hidden_size,
+        )
+        batch = dataset.get_batch()
+    init_time = t_init.elapsed
+    metrics.record_scalar("init_time_s", init_time)
 
     out_sharding = NamedSharding(mesh, batch_spec(mesh))
     step = jax.jit(
@@ -101,13 +97,13 @@ def run_e2e(
     mode = resolve_timing_mode("auto")
 
     with annotate("compile+warmup"):
-        t0 = time.perf_counter()
-        if comp_opts and mode == "per_iter":
-            step = step.lower(params, batch).compile(
-                compiler_options=comp_opts
-            )
-        force_completion(step(params, batch))
-        compile_time = time.perf_counter() - t0
+        with Timer() as t_compile:
+            if comp_opts and mode == "per_iter":
+                step = step.lower(params, batch).compile(
+                    compiler_options=comp_opts
+                )
+            force_completion(step(params, batch))
+        compile_time = t_compile.elapsed
 
     with annotate("measure"):
         if mode == "per_iter":
@@ -125,6 +121,10 @@ def run_e2e(
                 chunk_size=min(5, iters), op_args=(params,),
                 compiler_options=comp_opts or None,
             )
+
+    for t in forward_times:
+        metrics.record("forward_time", t)
+    summary = metrics.summary()
 
     # cross-host spread of mean forward time (run_mpi.py:199-212 analogue)
     local_mean = float(np.mean(forward_times))
@@ -154,10 +154,10 @@ def run_e2e(
             "dtype": model_cfg.dtype,
         },
         "mesh": plan.mesh_dict(),
-        "init_time_s": init_time,
+        "init_time_s": summary["init_time_s"],
         "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
-        "forward_time": summarize(forward_times),
+        "forward_time": summary["forward_time"],
         **timing_meta,
         "per_host_means_s": host_means.tolist(),
         "cross_host_variance": variance,

@@ -66,6 +66,17 @@ def build_parser() -> argparse.ArgumentParser:
     s3.add_argument("--output", required=True)
     s3.add_argument("--impl", default="xla_tpu")
 
+    cp = sub.add_parser(
+        "compare",
+        help="reference-vs-dlbb_tpu head-to-head comparison report "
+             "(CSV + markdown, per-config match/beat/lose verdicts)",
+    )
+    cp.add_argument("--reference", default="/root/reference",
+                    help="reference repo root (holds collectives/{1d,3d}/results)")
+    cp.add_argument("--own-1d", default="results/1d/xla_tpu")
+    cp.add_argument("--own-3d", default="results/3d/xla_tpu")
+    cp.add_argument("--output", default="stats/compare")
+
     e2 = sub.add_parser("e2e", help="end-to-end TP transformer forward benchmark")
     e2.add_argument("--config", required=True, help="YAML experiment config")
     e2.add_argument("--simulate", type=int, default=0, metavar="N")
@@ -202,6 +213,22 @@ def _dispatch(args) -> int:
 
         results = process_3d_results(args.input, args.output, args.impl)
         print(f"processed {len(results)} result files")
+        return 0
+
+    if args.cmd == "compare":
+        from pathlib import Path
+
+        from dlbb_tpu.stats import write_comparison
+
+        summary = write_comparison(
+            Path(args.reference), Path(args.own_1d), Path(args.own_3d),
+            Path(args.output), repo_root=Path.cwd(),
+        )
+        for dim in ("1d", "3d"):
+            s = summary[dim]
+            print(f"{dim}: {s['configs']} configs — {s['beat']} beat, "
+                  f"{s['match']} match, {s['lose']} lose")
+        print(f"report written to {args.output}/COMPARISON.md")
         return 0
 
     if args.cmd == "e2e":

@@ -54,14 +54,23 @@ def create_dataset_from_config(
     mesh: Optional[Mesh] = None,
     spec: Optional[PartitionSpec] = None,
     dtype=jnp.bfloat16,
+    hidden_size: Optional[int] = None,
+    seed_offset: int = 0,
 ) -> SyntheticEmbeddingDataset:
     """Build from the YAML ``input:`` + ``model:`` sections (reference
-    ``create_dataset_from_config`` ``data_gen.py:56-73``)."""
+    ``create_dataset_from_config`` ``data_gen.py:56-73``).
+
+    ``hidden_size`` overrides the raw ``model.hidden_size`` key for configs
+    that name a model size (``size: "7B"``) instead of spelling dimensions
+    out; ``seed_offset`` derives independent batches (e.g. training
+    targets) from the same config."""
+    if hidden_size is None:
+        hidden_size = config["model"]["hidden_size"]
     return SyntheticEmbeddingDataset(
         batch_size=config["input"]["batch_size"],
         seq_length=config["input"]["sequence_length"],
-        hidden_size=config["model"]["hidden_size"],
-        seed=config["input"].get("seed", 42),
+        hidden_size=hidden_size,
+        seed=config["input"].get("seed", 42) + seed_offset,
         dtype=dtype,
         mesh=mesh,
         spec=spec,

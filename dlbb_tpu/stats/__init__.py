@@ -6,6 +6,7 @@ Reference-compatible schemas: 1D per-file ``*_stats.json`` + consolidated CSV
 elegance (SURVEY §7 step 3) — this is the judged artifact format.
 """
 
+from dlbb_tpu.stats.compare import write_comparison
 from dlbb_tpu.stats.stats1d import (
     calculate_bandwidth,
     calculate_statistics,
@@ -18,4 +19,5 @@ __all__ = [
     "calculate_bandwidth",
     "process_1d_results",
     "process_3d_results",
+    "write_comparison",
 ]

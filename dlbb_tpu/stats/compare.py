@@ -1,0 +1,343 @@
+"""Reference-vs-dlbb_tpu head-to-head comparison report.
+
+Runs the repo's own stats pipeline over BOTH artifact corpora — the
+reference's checked-in result JSONs (``/root/reference/collectives/{1d,3d}/
+results/<backend>/``, its §6 published baseline) and this repo's
+``results/{1d,3d}/`` — joins them per configuration, and emits one committed
+CSV + markdown report stating, per (op x size x ranks) point, whether
+``xla_tpu`` matches, beats, or loses to the BEST reference backend at that
+point (best = lowest mean time across openmpi / intelmpi / dsgloo / dsccl
+and, for 3D, every dsccl tuning variant directory).
+
+Honesty caveats (carried into the report header):
+
+- the reference corpus was measured on its 56-core CPU node with real
+  MPI/oneCCL processes; this repo's committed corpus is the CPU-*simulated*
+  8-device mesh on this image's single core (XLA collectives over host RAM,
+  not ICI — there is no multi-chip TPU here to measure).  The comparison is
+  therefore stack-vs-stack at equal rank counts, not fabric-vs-fabric.
+- chunked-timing rows (``timing_granularity`` column) aggregate chunk
+  means; mean comparisons remain valid, tail comparisons do not.
+- the reference publishes no E2E number (BASELINE.md); the E2E section
+  compares against the re-measured reference-stack torch-CPU baseline
+  (``bench_baseline_cpu.json``) and reports the TPU-chip numbers from
+  ``BENCH_r*.json``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+from dlbb_tpu.stats.stats1d import process_file as process_1d_file
+from dlbb_tpu.stats.stats3d import calculate_statistics_3d
+
+# Above/below these speedup thresholds the verdict is beat/lose; between
+# them the difference is within run-to-run noise and counts as a match.
+BEAT, LOSE = 1.05, 0.95
+
+COLUMNS_1D = [
+    "operation", "data_size_name", "num_ranks",
+    "ref_best_backend", "ref_best_mean_us", "ref_best_bandwidth_gbps",
+    "xla_mean_us", "xla_bandwidth_gbps", "speedup", "verdict",
+]
+
+COLUMNS_3D = [
+    "operation", "num_ranks", "batch", "seq_len", "hidden_dim",
+    "tensor_size_mb", "ref_best_backend", "ref_best_mean_ms",
+    "xla_mean_ms", "speedup", "verdict",
+]
+
+
+def _verdict(speedup: float) -> str:
+    if speedup >= BEAT:
+        return "beat"
+    if speedup <= LOSE:
+        return "lose"
+    return "match"
+
+
+def _rows_1d(results_dir: Path) -> list[dict[str, Any]]:
+    """Stats rows for every 1D result JSON in one directory (in memory —
+    same math as ``process_1d_results``, no artifacts written)."""
+    rows = []
+    for f in sorted(Path(results_dir).glob("*.json")):
+        if f.name.endswith("_stats.json"):
+            continue
+        try:
+            rows.append(process_1d_file(f))
+        except Exception:  # noqa: BLE001 — per-file resilience
+            continue
+    return rows
+
+
+def _rows_3d(results_dir: Path, backend: str) -> list[dict[str, Any]]:
+    rows = []
+    for f in sorted(Path(results_dir).glob("*.json")):
+        if f.name.endswith("_stats.json"):
+            continue
+        try:
+            data = json.loads(f.read_text())
+            shape = data["tensor_shape"]
+            rows.append({
+                "backend": backend,
+                "operation": data["operation"],
+                "num_ranks": data["num_ranks"],
+                "batch": shape["batch"],
+                "seq_len": shape["seq_len"],
+                "hidden_dim": shape["hidden_dim"],
+                "tensor_size_mb": data["tensor_size_mb"],
+                **calculate_statistics_3d(data["timings"]),
+            })
+        except Exception:  # noqa: BLE001
+            continue
+    return rows
+
+
+def compare_1d(
+    ref_results_root: Path, own_results_dir: Path
+) -> list[dict[str, Any]]:
+    """Join per (operation, data_size_name, num_ranks); one output row per
+    config both corpora cover."""
+    own = _rows_1d(own_results_dir)
+    if not own:
+        return []
+    ref_best: dict[tuple, dict] = {}
+    for backend_dir in sorted(Path(ref_results_root).iterdir()):
+        if not backend_dir.is_dir():
+            continue
+        for r in _rows_1d(backend_dir):
+            key = (r["operation"], r["data_size_name"], r["num_ranks"])
+            if (key not in ref_best
+                    or r["mean_time_us"] < ref_best[key]["mean_time_us"]):
+                ref_best[key] = dict(r, backend=backend_dir.name)
+
+    out = []
+    for r in own:
+        key = (r["operation"], r["data_size_name"], r["num_ranks"])
+        ref = ref_best.get(key)
+        if ref is None:
+            continue
+        speedup = ref["mean_time_us"] / r["mean_time_us"]
+        out.append({
+            "operation": key[0],
+            "data_size_name": key[1],
+            "num_ranks": key[2],
+            "ref_best_backend": ref["backend"],
+            "ref_best_mean_us": round(ref["mean_time_us"], 3),
+            "ref_best_bandwidth_gbps": (
+                round(ref["bandwidth_gbps"], 4)
+                if ref["bandwidth_gbps"] is not None else None
+            ),
+            "xla_mean_us": round(r["mean_time_us"], 3),
+            "xla_bandwidth_gbps": (
+                round(r["bandwidth_gbps"], 4)
+                if r["bandwidth_gbps"] is not None else None
+            ),
+            "speedup": round(speedup, 4),
+            "verdict": _verdict(speedup),
+        })
+    out.sort(key=lambda r: (r["operation"], r["num_ranks"],
+                            r["xla_mean_us"]))
+    return out
+
+
+def compare_3d(
+    ref_results_root: Path, own_results_dir: Path
+) -> list[dict[str, Any]]:
+    """Join per (operation, ranks, batch, seq, hidden).  Every reference
+    directory — the four backends AND the dsccl tuning variants — competes
+    for "best", because the tuned runs are legitimately the reference's
+    best published numbers (SURVEY §2.3)."""
+    own = _rows_3d(own_results_dir, "xla_tpu")
+    if not own:
+        return []
+    ref_best: dict[tuple, dict] = {}
+    for backend_dir in sorted(Path(ref_results_root).iterdir()):
+        if not backend_dir.is_dir():
+            continue
+        for r in _rows_3d(backend_dir, backend_dir.name):
+            key = (r["operation"], r["num_ranks"], r["batch"],
+                   r["seq_len"], r["hidden_dim"])
+            if (key not in ref_best
+                    or r["mean_time_ms"] < ref_best[key]["mean_time_ms"]):
+                ref_best[key] = r
+
+    out = []
+    for r in own:
+        key = (r["operation"], r["num_ranks"], r["batch"],
+               r["seq_len"], r["hidden_dim"])
+        ref = ref_best.get(key)
+        if ref is None:
+            continue
+        speedup = ref["mean_time_ms"] / r["mean_time_ms"]
+        out.append({
+            "operation": key[0], "num_ranks": key[1], "batch": key[2],
+            "seq_len": key[3], "hidden_dim": key[4],
+            "tensor_size_mb": r["tensor_size_mb"],
+            "ref_best_backend": ref["backend"],
+            "ref_best_mean_ms": round(ref["mean_time_ms"], 4),
+            "xla_mean_ms": round(r["mean_time_ms"], 4),
+            "speedup": round(speedup, 4),
+            "verdict": _verdict(speedup),
+        })
+    out.sort(key=lambda r: (r["operation"], r["num_ranks"],
+                            r["hidden_dim"], r["seq_len"], r["batch"]))
+    return out
+
+
+def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
+    """E2E tokens/s vs the reference-stack CPU baseline, from the committed
+    bench artifacts (TPU-chip numbers, not the simulated mesh)."""
+    rows = []
+    cpu = repo_root / "bench_baseline_cpu.json"
+    if not cpu.exists():
+        return rows
+    base = json.loads(cpu.read_text())
+    base_tps = base["tokens_per_second"]
+    for bench_file in sorted(repo_root.glob("BENCH_r*.json")):
+        try:
+            b = json.loads(bench_file.read_text())
+        except Exception:  # noqa: BLE001
+            continue
+        # driver BENCH records nest the bench.py line under "parsed"
+        b = b.get("parsed", b)
+        if "tokens/s" not in b.get("unit", ""):
+            continue
+        rows.append({
+            "config": f"1B/simplified ({bench_file.name})",
+            "reference_cpu_stack_tokens_per_s": round(base_tps, 1),
+            "xla_tpu_tokens_per_s": b["value"],
+            "speedup": round(b["value"] / base_tps, 2),
+            "verdict": _verdict(b["value"] / base_tps),
+        })
+        for name, extra in b.get("extras", {}).items():
+            rows.append({
+                "config": f"{name} ({bench_file.name})",
+                "reference_cpu_stack_tokens_per_s": None,
+                "xla_tpu_tokens_per_s": extra["tokens_per_second"],
+                "speedup": None,
+                "verdict": "(no reference number)",
+            })
+    return rows
+
+
+def _counts(rows: list[dict]) -> dict[str, int]:
+    c = {"beat": 0, "match": 0, "lose": 0}
+    for r in rows:
+        if r["verdict"] in c:
+            c[r["verdict"]] += 1
+    return c
+
+
+def _md_table(rows: list[dict], columns: list[str]) -> list[str]:
+    lines = ["| " + " | ".join(columns) + " |",
+             "|" + "---|" * len(columns)]
+    for r in rows:
+        lines.append(
+            "| " + " | ".join(str(r.get(c, "")) for c in columns) + " |"
+        )
+    return lines
+
+
+def _write_csv(rows: list[dict], columns: list[str], path: Path) -> None:
+    import csv
+
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=columns)
+        w.writeheader()
+        for r in rows:
+            w.writerow({k: r.get(k) for k in columns})
+
+
+def write_comparison(
+    ref_root: Path,
+    own_1d: Path,
+    own_3d: Path,
+    out_dir: Path,
+    repo_root: Optional[Path] = None,
+) -> dict[str, Any]:
+    """Produce ``comparison_{1d,3d}.csv`` + ``COMPARISON.md`` in
+    ``out_dir``; returns the summary dict (also saved as JSON)."""
+    ref_root = Path(ref_root)
+    out_dir = Path(out_dir)
+    rows_1d = compare_1d(ref_root / "collectives" / "1d" / "results", own_1d)
+    rows_3d = compare_3d(ref_root / "collectives" / "3d" / "results", own_3d)
+    e2e = _e2e_rows(repo_root) if repo_root else []
+
+    _write_csv(rows_1d, COLUMNS_1D, out_dir / "comparison_1d.csv")
+    _write_csv(rows_3d, COLUMNS_3D, out_dir / "comparison_3d.csv")
+
+    c1, c3 = _counts(rows_1d), _counts(rows_3d)
+    summary = {
+        "1d": {"configs": len(rows_1d), **c1},
+        "3d": {"configs": len(rows_3d), **c3},
+        "e2e": e2e,
+        "thresholds": {"beat": BEAT, "lose": LOSE},
+    }
+
+    md = [
+        "# Reference vs dlbb_tpu — head-to-head comparison",
+        "",
+        "Per-config join of the reference's checked-in baseline corpus "
+        "(`/root/reference/collectives/{1d,3d}/results/`) against this "
+        "repo's committed `results/{1d,3d}/` corpus, both processed by "
+        "this repo's stats pipeline.  `ref_best_*` is the fastest "
+        "reference backend (incl. dsccl tuning variants) at that config; "
+        "`speedup` = ref_best_mean / xla_mean (>1 = xla_tpu faster); "
+        f"verdict thresholds: beat >= {BEAT}x, lose <= {LOSE}x.",
+        "",
+        "**Caveats** (see `dlbb_tpu/stats/compare.py` docstring): the "
+        "reference corpus ran real MPI/oneCCL ranks on a 56-core node; "
+        "this repo's corpus runs the CPU-simulated 8-device mesh on this "
+        "image's single core (host-RAM collectives, not ICI).  The join "
+        "covers the rank counts both corpora measured.  E2E rows are "
+        "real-TPU-chip numbers vs the re-measured reference-stack "
+        "torch-CPU baseline.",
+        "",
+        "## Summary",
+        "",
+        f"- **1D** ({len(rows_1d)} configs): {c1['beat']} beat, "
+        f"{c1['match']} match, {c1['lose']} lose",
+        f"- **3D** ({len(rows_3d)} configs): {c3['beat']} beat, "
+        f"{c3['match']} match, {c3['lose']} lose",
+        "",
+    ]
+    if e2e:
+        md += ["## E2E forward throughput (real TPU chip)", ""]
+        md += _md_table(
+            e2e,
+            ["config", "reference_cpu_stack_tokens_per_s",
+             "xla_tpu_tokens_per_s", "speedup", "verdict"],
+        )
+        md.append("")
+    md += ["## 1D collectives (full table)", ""]
+    md += _md_table(rows_1d, COLUMNS_1D)
+    md += ["", "## 3D collectives (per op x ranks aggregate; "
+           "full detail in comparison_3d.csv)", ""]
+    agg_rows = []
+    for (op, ranks) in sorted({(r["operation"], r["num_ranks"])
+                               for r in rows_3d}):
+        sub = [r for r in rows_3d
+               if r["operation"] == op and r["num_ranks"] == ranks]
+        cs = _counts(sub)
+        agg_rows.append({
+            "operation": op, "num_ranks": ranks, "configs": len(sub),
+            "beat": cs["beat"], "match": cs["match"], "lose": cs["lose"],
+            "median_speedup": round(
+                float(np.median([r["speedup"] for r in sub])), 3),
+        })
+    md += _md_table(agg_rows, ["operation", "num_ranks", "configs", "beat",
+                               "match", "lose", "median_speedup"])
+    md.append("")
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    (out_dir / "COMPARISON.md").write_text("\n".join(md))
+    (out_dir / "comparison_summary.json").write_text(
+        json.dumps(summary, indent=2) + "\n"
+    )
+    return summary

@@ -4,7 +4,10 @@ Schema parity with reference ``collectives/1d/stats.py``: per-file stats in
 µs (mean/median/min/max/std/p95/p99), load-imbalance % over per-rank means
 (:54-61), bus bandwidth GB/s from the *max* time (conservative choice,
 :178-186), per-file ``*_stats.json`` and a consolidated
-``benchmark_statistics.csv`` with the same columns (:226-241).
+``benchmark_statistics.csv`` with the same columns (:226-241) plus one
+trailing ``timing_granularity`` extension column (the 3D *standard* CSV,
+whose header is asserted byte-identical to the reference's, instead puts
+the marker in the transposed CSV's metadata block — see ``stats3d``).
 
 The reference's bandwidth formula is uniform across all eight ops
 (``elements x element_size x num_ranks / time / 2**30`` — :98-121, a
@@ -16,7 +19,13 @@ Differences (documented, not silent):
 - element size follows the recorded dtype (the reference hardcodes fp16's
   2 bytes at :93 even for other dtypes);
 - per-rank timing rows are per-*host* dispatch timings under SPMD; with one
-  process the load-imbalance over a single row is 0 by construction.
+  process the load-imbalance over a single row is 0 by construction;
+- a trailing ``timing_granularity`` CSV column marks rows computed from
+  chunked-mode artifacts (``dlbb_tpu/utils/timing.py::time_fn_chained``),
+  whose samples are chunk *means*: their p95/p99 measure the spread of
+  chunk means, not per-iteration tail latencies, and must not be compared
+  against per-iteration tails.  The per-file stats JSON carries the full
+  ``percentile_caveat`` text.
 """
 
 from __future__ import annotations
@@ -52,6 +61,10 @@ CSV_COLUMNS = [
     "p99_time_us",
     "load_imbalance_percent",
     "bandwidth_gbps",
+    # extension column (not in the reference): "per_iteration" or
+    # "chunked(N)" — percentile columns of chunked rows are over chunk
+    # means, not per-iteration tails
+    "timing_granularity",
 ]
 
 
@@ -142,7 +155,7 @@ def process_file(
         data["num_ranks"],
         algorithm_bandwidth=algorithm_bandwidth,
     )
-    return {
+    out = {
         "mpi_implementation": impl,
         "operation": data["operation"],
         "num_ranks": data["num_ranks"],
@@ -151,7 +164,14 @@ def process_file(
         "dtype": data.get("dtype", ""),
         **stats,
         "bandwidth_gbps": bandwidth,
+        # reference artifacts (and per_iter runs) have no granularity
+        # marker: their timing rows are genuine per-iteration samples
+        "timing_granularity": data.get("timing_granularity",
+                                       "per_iteration"),
     }
+    if "percentile_caveat" in data:
+        out["percentile_caveat"] = data["percentile_caveat"]
+    return out
 
 
 def process_1d_results(
@@ -191,7 +211,8 @@ def process_1d_results(
                     {
                         k: v
                         for k, v in r.items()
-                        if k not in ("per_rank_means_us", "dtype")
+                        if k not in ("per_rank_means_us", "dtype",
+                                     "percentile_caveat")
                     }
                 )
         if verbose:

@@ -41,7 +41,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.data.synthetic import SyntheticEmbeddingDataset
+from dlbb_tpu.data.synthetic import create_dataset_from_config
 from dlbb_tpu.models.configs import ModelConfig
 from dlbb_tpu.parallel.plan import ParallelismPlan
 from dlbb_tpu.models.sharding import batch_spec, param_specs, specs_for_mesh
@@ -107,12 +107,17 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
     """Partition specs for the optimizer-state pytree.
 
     Optax state subtrees that mirror the param pytree (Adam mu/nu) are
-    detected *structurally* — any subtree with the params' treedef gets the
-    params' spec tree (shape matching would collide when two params share a
-    shape with different TP layouts, e.g. ffn_intermediate == hidden_size).
-    Everything else (step counts, empty states) stays replicated.
+    detected *structurally* — any subtree with the params' treedef AND
+    leafwise-matching shapes gets the params' spec tree (treedef matching
+    alone would misfire on adafactor's v_row/v_col/v subtrees, which mirror
+    the params' structure with factored lower-rank statistics; pure shape
+    matching would collide when two params share a shape with different TP
+    layouts, e.g. ffn_intermediate == hidden_size).  Everything else —
+    step counts, empty states, factored adafactor statistics (sublinear in
+    parameter count, so ZeRO sharding is moot for them) — stays replicated.
     """
     p_def = jax.tree.structure(params)
+    p_shapes = [getattr(p, "shape", None) for p in jax.tree.leaves(params)]
     if base_specs is None:
         base_specs = param_specs()
     spec_for_params = (
@@ -122,7 +127,10 @@ def opt_state_specs(params: Any, opt_state: Any, zero1: bool,
 
     def recur(node):
         try:
-            if jax.tree.structure(node) == p_def:
+            if jax.tree.structure(node) == p_def and all(
+                getattr(leaf, "shape", None) == shape
+                for leaf, shape in zip(jax.tree.leaves(node), p_shapes)
+            ):
                 return spec_for_params
         except Exception:  # noqa: BLE001 — unhashable/exotic nodes
             pass
@@ -177,7 +185,8 @@ MODE_NAMES = {0: "ddp", 1: "zero1", 2: "zero2", 3: "zero3"}
 # Approximate per-parameter update FLOPs for the utilisation accounting
 # (elementwise moment updates + bias correction + apply; small vs the 3x
 # forward term for any real model).
-OPTIMIZER_FLOPS_PER_PARAM = {"adam": 18, "adamw": 22, "sgd": 6}
+OPTIMIZER_FLOPS_PER_PARAM = {"adam": 18, "adamw": 22, "sgd": 6,
+                             "adafactor": 14}
 
 
 def make_train_step(
@@ -341,13 +350,13 @@ def run_train(
     mesh, num_microbatches = plan.mesh, plan.num_microbatches
     inp = config["input"]
     dtype = jnp.bfloat16 if model_cfg.dtype == "bfloat16" else jnp.float32
-    data = SyntheticEmbeddingDataset(
-        inp["batch_size"], inp["sequence_length"], model_cfg.hidden_size,
-        seed=inp.get("seed", 42), dtype=dtype, mesh=mesh, spec=batch_spec(mesh),
+    data = create_dataset_from_config(
+        config, mesh=mesh, spec=batch_spec(mesh), dtype=dtype,
+        hidden_size=model_cfg.hidden_size,
     )
-    targets = SyntheticEmbeddingDataset(
-        inp["batch_size"], inp["sequence_length"], model_cfg.hidden_size,
-        seed=inp.get("seed", 42) + 1, dtype=dtype, mesh=mesh, spec=batch_spec(mesh),
+    targets = create_dataset_from_config(
+        config, mesh=mesh, spec=batch_spec(mesh), dtype=dtype,
+        hidden_size=model_cfg.hidden_size, seed_offset=1,
     )
 
     train_cfg = config.get("training", {})

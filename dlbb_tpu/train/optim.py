@@ -4,8 +4,14 @@ The reference trains only with Adam at a fixed LR (DeepSpeed config at
 ``test/ccl.py:74-89``, ``test/ds_mpi_test.py:16-24``); a complete framework
 needs the standard optimizer/schedule matrix, built here from optax:
 
-optimizer: adam (default) | adamw | sgd
+optimizer: adam (default) | adamw | sgd | adafactor
 schedule:  constant (default) | cosine | warmup_cosine
+
+``adafactor`` is the TPU-idiomatic large-model choice: factored second
+moments make optimizer state sublinear in parameter count (Adam's mu/nu
+double a 13B model's memory; adafactor adds row+column statistics only),
+which is what lets the full 13B train-step artifact fit the single host
+that simulates the 8-device mesh (``scripts/publish_baselines.py``).
 """
 
 from __future__ import annotations
@@ -14,7 +20,7 @@ from typing import Any
 
 import optax
 
-OPTIMIZERS = ("adam", "adamw", "sgd")
+OPTIMIZERS = ("adam", "adamw", "sgd", "adafactor")
 SCHEDULES = ("constant", "cosine", "warmup_cosine")
 DEFAULT_OPTIMIZER = "adam"
 DEFAULT_SCHEDULE = "constant"
@@ -67,6 +73,8 @@ def build_optimizer(train_cfg: dict[str, Any]) -> optax.GradientTransformation:
     if name == "sgd":
         momentum = train_cfg.get("momentum", 0.9)
         return optax.sgd(schedule, momentum=momentum)
+    if name == "adafactor":
+        return optax.adafactor(learning_rate=schedule)
     raise ValueError(
         f"unknown training.optimizer {name!r}; known: {OPTIMIZERS}"
     )

@@ -61,6 +61,10 @@ GIB = 2**30
 
 # Executable variant matrix (the fusion/threshold XLA_FLAGS variants need a
 # real pod launcher and are excluded — see dlbb_tpu/comm/variants.py).
+# "nofuse" is also excluded here: disabling the collective-combiner passes
+# is a null experiment on single-collective 1D programs (nothing to
+# combine — variants.py admits this); its honest measurement is the train
+# stage's fused/nofuse comparison over many-collective ZeRO steps.
 EXECUTABLE_VARIANTS = (
     "default",
     "ring",
@@ -70,7 +74,6 @@ EXECUTABLE_VARIANTS = (
     "hier4x2",
     "grid2x2x2",
     "hier2x2x2",
-    "nofuse",
 )
 
 TRAIN_MODEL = {
@@ -166,6 +169,26 @@ def stage_train() -> None:
             run_train(config, zero_stage=stage, output_dir=str(out))
 
 
+def stage_multichip() -> None:
+    """The headline bench.py multi-chip branch (BASELINE.json metric), run
+    on the simulated 8-device mesh so the artifact exists even though the
+    TPU image has one chip.  The JSON line is exactly what bench.py would
+    print with >= 2 accelerator devices."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", REPO / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    log("multichip headline (8-rank simulated mesh)")
+    out = bench.bench_allreduce_multichip(8)
+    out["host"] = "cpu-simulated 8-device mesh (host-RAM bandwidth, not ICI)"
+    dest = RESULTS / "multichip" / "bench_allreduce_multichip_8ranks.json"
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    dest.write_text(json.dumps(out, indent=2) + "\n")
+    log(f"  {out['value']} {out['unit']} "
+        f"(vs oneCCL baseline x{out['vs_baseline']})")
+
+
 def stage_stats() -> None:
     from dlbb_tpu.stats import process_1d_results, process_3d_results
 
@@ -182,6 +205,26 @@ def stage_stats() -> None:
         if in_dir.exists():
             process_1d_results(in_dir, STATS / "variants" / impl,
                                verbose=False)
+
+
+def stage_compare() -> None:
+    """Head-to-head vs the reference's own checked-in corpus
+    (``dlbb_tpu/stats/compare.py``) — the evidence for match/beat/lose
+    per config, committed under ``stats/compare/``."""
+    from dlbb_tpu.stats import write_comparison
+
+    log("compare: reference corpus vs repo corpus")
+    summary = write_comparison(
+        Path("/root/reference"),
+        RESULTS / "1d" / "xla_tpu",
+        RESULTS / "3d" / "xla_tpu",
+        STATS / "compare",
+        repo_root=REPO,
+    )
+    for dim in ("1d", "3d"):
+        s = summary[dim]
+        log(f"  {dim}: {s['configs']} configs — {s['beat']} beat, "
+            f"{s['match']} match, {s['lose']} lose")
 
 
 def stage_baseline() -> None:
@@ -219,6 +262,9 @@ def stage_baseline() -> None:
              ("num_ranks", "mean_time_us", "bandwidth_gbps")}
             for r in pick
         ]
+    mc = RESULTS / "multichip" / "bench_allreduce_multichip_8ranks.json"
+    if mc.exists():
+        published["multichip_headline"] = json.loads(mc.read_text())
     train_dir = RESULTS / "train"
     if train_dir.exists():
         ladder = {}
@@ -241,7 +287,9 @@ STAGES = {
     "3d": stage_3d,
     "variants": stage_variants,
     "train": stage_train,
+    "multichip": stage_multichip,
     "stats": stage_stats,
+    "compare": stage_compare,
     "baseline": stage_baseline,
 }
 

@@ -169,6 +169,178 @@ def test_sweep_3d_and_stats(tmp_path, devices):
     assert lines[1].startswith("mean_time_ms,")
 
 
+def test_stats_1d_granularity_marker(tmp_path):
+    """Chained-mode artifacts (whose samples are chunk MEANS — percentiles
+    are not per-iteration tails) must be distinguishable from per-iteration
+    ones in both the per-file stats JSON and the consolidated CSV."""
+    base = {
+        "implementation": "xla_test", "operation": "allreduce",
+        "num_ranks": 4, "data_size_name": "1KB", "num_elements": 256,
+        "dtype": "bfloat16", "warmup_iterations": 1,
+        "measurement_iterations": 3, "timings": [[1e-4, 1.2e-4, 0.9e-4]],
+    }
+    chained = dict(
+        base, operation="broadcast", timing_granularity="chunked(5)",
+        percentile_caveat="percentiles are over 5-iteration chunk means, "
+                          "not per-iteration tails",
+    )
+    d = tmp_path / "r"
+    d.mkdir()
+    (d / "xla_test_allreduce_ranks4_1KB.json").write_text(json.dumps(base))
+    (d / "xla_test_broadcast_ranks4_1KB.json").write_text(json.dumps(chained))
+    results = process_1d_results(d, tmp_path / "s", verbose=False)
+    by_op = {r["operation"]: r for r in results}
+    assert by_op["allreduce"]["timing_granularity"] == "per_iteration"
+    assert by_op["broadcast"]["timing_granularity"] == "chunked(5)"
+    csv_lines = (
+        tmp_path / "s" / "benchmark_statistics.csv"
+    ).read_text().splitlines()
+    assert csv_lines[0].endswith("timing_granularity")
+    assert any(line.endswith("chunked(5)") for line in csv_lines[1:])
+    assert any(line.endswith("per_iteration") for line in csv_lines[1:])
+    # the full caveat text lands in the per-file stats JSON
+    stats = json.loads(
+        (tmp_path / "s" / "xla_test_broadcast_ranks4_1KB_stats.json")
+        .read_text()
+    )
+    assert "chunk means" in stats["percentile_caveat"]
+
+
+def test_stats_3d_granularity_marker(tmp_path):
+    """3D: the standard CSV header is the reference contract (unchanged);
+    the granularity marker rides the transposed CSV's metadata block."""
+    art = {
+        "implementation": "xla_test", "operation": "allreduce",
+        "num_ranks": 4, "num_elements": 128,
+        "tensor_shape": {"batch": 1, "seq_len": 8, "hidden_dim": 16},
+        "tensor_size_mb": 0.000244140625,
+        "timing_granularity": "chunked(5)",
+        "timings": [[1e-3, 1.1e-3]],
+    }
+    d = tmp_path / "r3"
+    d.mkdir()
+    (d / "xla_test_allreduce_ranks4_b1_s8_h16.json").write_text(
+        json.dumps(art)
+    )
+    process_3d_results(d, tmp_path / "s3", "xla_test", verbose=False)
+    header = (
+        tmp_path / "s3" / "benchmark_statistics_3d_xla_test_standard.csv"
+    ).read_text().splitlines()[0]
+    assert "timing_granularity" not in header  # reference contract intact
+    tr = (
+        tmp_path / "s3" / "benchmark_statistics_3d_xla_test_transpose.csv"
+    ).read_text()
+    assert "timing_granularity,chunked(5)" in tr
+
+
+def _write_1d_artifact(path, impl, op, ranks, size_name, n, mean_s):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps({
+        "mpi_implementation": impl, "operation": op, "num_ranks": ranks,
+        "data_size_name": size_name, "num_elements": n, "dtype": "bfloat16",
+        "warmup_iterations": 1, "measurement_iterations": 2,
+        "timings": [[mean_s, mean_s]] * ranks,
+    }))
+
+
+def test_compare_1d_verdicts(tmp_path):
+    """The comparison join picks the best reference backend per config and
+    classifies beat/match/lose by the speedup thresholds."""
+    from dlbb_tpu.stats.compare import compare_1d
+
+    ref = tmp_path / "ref"
+    # slow backend and fast backend: best must be 'fast' (1 ms)
+    _write_1d_artifact(ref / "slow" / "a.json", "slow", "allreduce", 4,
+                       "1KB", 256, 5e-3)
+    _write_1d_artifact(ref / "fast" / "a.json", "fast", "allreduce", 4,
+                       "1KB", 256, 1e-3)
+    # config only the reference covers (ranks=16) must not produce a row
+    _write_1d_artifact(ref / "fast" / "b.json", "fast", "allreduce", 16,
+                       "1KB", 256, 1e-3)
+    own = tmp_path / "own"
+    _write_1d_artifact(own / "a.json", "xla_tpu", "allreduce", 4,
+                       "1KB", 256, 0.5e-3)  # 2x faster -> beat
+    _write_1d_artifact(own / "c.json", "xla_tpu", "broadcast", 4,
+                       "1KB", 256, 1e-3)    # no ref config -> dropped
+    rows = compare_1d(ref, own)
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["ref_best_backend"] == "fast"
+    assert r["speedup"] == 2.0
+    assert r["verdict"] == "beat"
+
+
+def test_compare_report_against_reference_corpus(tmp_path, devices):
+    """End-to-end: a real (tiny) sweep's artifacts joined against the
+    reference's actual checked-in 1D corpus produce the committed report
+    files with a verdict per covered config."""
+    import pytest
+
+    from dlbb_tpu.stats.compare import write_comparison
+
+    ref_root = __import__("pathlib").Path("/root/reference")
+    if not (ref_root / "collectives" / "1d" / "results").exists():
+        pytest.skip("reference corpus not available")
+    run_sweep(
+        _tiny_1d(tmp_path, operations=("allreduce",),
+                 data_sizes=(("1KB", 256),), rank_counts=(2, 4),
+                 implementation="xla_tpu"),
+        verbose=False,
+    )
+    out = tmp_path / "cmp"
+    summary = write_comparison(
+        ref_root, tmp_path / "results", tmp_path / "none3d", out
+    )
+    assert summary["1d"]["configs"] == 2  # ranks 2 and 4 joined
+    assert sum(summary["1d"][k] for k in ("beat", "match", "lose")) == 2
+    assert (out / "COMPARISON.md").exists()
+    assert (out / "comparison_1d.csv").exists()
+    md = (out / "COMPARISON.md").read_text()
+    assert "allreduce" in md and "Caveats" in md
+
+
+def test_compare_e2e_reads_driver_bench_records(tmp_path):
+    """Driver BENCH_r*.json files nest the bench.py line under 'parsed';
+    the E2E section must unwrap it (regression: silently-empty section)."""
+    from dlbb_tpu.stats.compare import _e2e_rows
+
+    (tmp_path / "bench_baseline_cpu.json").write_text(json.dumps(
+        {"tokens_per_second": 100.0}
+    ))
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps({
+        "n": 1, "cmd": "python bench.py", "rc": 0,
+        "parsed": {"metric": "e2e", "value": 250.0, "unit": "tokens/s",
+                   "vs_baseline": 2.5,
+                   "extras": {"7B_full": {"tokens_per_second": 50.0}}},
+    }))
+    rows = _e2e_rows(tmp_path)
+    assert len(rows) == 2
+    assert rows[0]["speedup"] == 2.5 and rows[0]["verdict"] == "beat"
+    assert rows[1]["xla_tpu_tokens_per_s"] == 50.0
+
+
+def test_bench_allreduce_multichip_schema(devices):
+    """The headline multi-chip branch of bench.py (never taken on the
+    single-chip image) runs on the simulated 8-device mesh: schema keys,
+    positive bandwidth, and the vs_baseline arithmetic hold."""
+    import bench
+
+    out = bench.bench_allreduce_multichip(
+        8, num_elements=262_144, warmup=1, iterations=5
+    )
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in out, key
+    assert out["metric"] == "1d_allreduce_1MB_bus_bandwidth_8ranks"
+    assert out["unit"] == "GB/s"
+    assert out["value"] > 0
+    assert out["max_time_s"] > 0
+    np.testing.assert_allclose(
+        out["vs_baseline"],
+        round(out["value"] / bench.ONECCL_BASELINE_GBPS, 3),
+        rtol=1e-9,
+    )
+
+
 def test_stats_reads_reference_artifact(tmp_path):
     """The pipeline must ingest the reference's own result JSONs (same
     schema, 'mpi_implementation' key)."""

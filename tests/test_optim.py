@@ -85,10 +85,23 @@ def test_pipeline_grad_accum_microbatch_validated(devices):
 @pytest.mark.parametrize("training", [
     {"optimizer": "adamw", "weight_decay": 0.01},
     {"optimizer": "sgd", "momentum": 0.9, "learning_rate": 0.05},
+    {"optimizer": "adafactor", "learning_rate": 0.05},
     {"schedule": "warmup_cosine", "warmup_steps": 2, "decay_steps": 20},
 ])
 def test_optimizer_variants_train(devices, training):
     result = run_train(_config(**training), verbose=False)
+    losses = result["losses"]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_adafactor_zero_stages(devices, stage):
+    """Regression: adafactor's v_row/v_col/v subtrees mirror the params'
+    treedef with lower-rank factored statistics; opt_state_specs must not
+    assign them the params' 2-D PartitionSpecs (crashed device_put)."""
+    result = run_train(_config(optimizer="adafactor", learning_rate=0.05),
+                       zero_stage=stage, verbose=False)
     losses = result["losses"]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], losses
