@@ -37,7 +37,7 @@ from dlbb_tpu.models.transformer import (
     num_parameters,
 )
 from dlbb_tpu.utils.config import load_config, save_json
-from dlbb_tpu.utils.metrics import MetricsCollector, Timer
+from dlbb_tpu.utils.metrics import Timer, summarize
 from dlbb_tpu.utils.profiling import annotate
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import (
@@ -56,7 +56,6 @@ def run_e2e(
 ) -> dict[str, Any]:
     """Run the benchmark described by ``config`` (schema:
     ``configs/baseline_config.yaml``; parity with ``run_mpi.py:main``)."""
-    metrics = MetricsCollector()
     with Timer() as t_init:
         model_cfg = ModelConfig.from_dict(config["model"])
         plan = ParallelismPlan.from_config(config, model_cfg, devices)
@@ -74,7 +73,6 @@ def run_e2e(
         )
         batch = dataset.get_batch()
     init_time = t_init.elapsed
-    metrics.record_scalar("init_time_s", init_time)
 
     out_sharding = NamedSharding(mesh, batch_spec(mesh))
     step = jax.jit(
@@ -122,10 +120,6 @@ def run_e2e(
                 compiler_options=comp_opts or None,
             )
 
-    for t in forward_times:
-        metrics.record("forward_time", t)
-    summary = metrics.summary()
-
     # cross-host spread of mean forward time (run_mpi.py:199-212 analogue)
     local_mean = float(np.mean(forward_times))
     if jax.process_count() > 1:
@@ -154,10 +148,10 @@ def run_e2e(
             "dtype": model_cfg.dtype,
         },
         "mesh": plan.mesh_dict(),
-        "init_time_s": summary["init_time_s"],
+        "init_time_s": init_time,
         "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
-        "forward_time": summary["forward_time"],
+        "forward_time": summarize(forward_times),
         **timing_meta,
         "per_host_means_s": host_means.tolist(),
         "cross_host_variance": variance,

@@ -18,21 +18,27 @@ class ModelConfig:
     num_layers: int
     num_heads: int
     ffn_intermediate: int
-    # "full" | "simplified" (reference parity) | "flash" (pallas kernel,
-    # dlbb_tpu.ops) | "ring" | "ulysses" (sequence/context-parallel
+    # "full" — exact causal/bidirectional MHA, auto-routed to the pallas
+    #   flash kernel on real TPUs at S >= transformer.FLASH_ROUTE_MIN_SEQ
+    #   (same math, faster kernel; dense einsum elsewhere);
+    # "dense" — exact MHA, einsum kernel always (opt-out of the routing);
+    # "simplified" (reference parity shortcut) | "flash" (force the pallas
+    # kernel, dlbb_tpu.ops) | "ring" | "ulysses" (sequence/context-parallel
     # attention — dlbb_tpu.parallel)
     attention: str = "full"
     dtype: str = "bfloat16"
     # Grouped-query attention: number of K/V heads (None = num_heads, i.e.
     # full MHA; 1 = MQA).  Query heads share K/V heads in groups of
     # num_heads // num_kv_heads.  The projection/params shrink in every
-    # mode; K/V activations additionally stay at kv_heads width through the
-    # dense "full" kernel (flash/ring/ulysses broadcast K/V to num_heads
-    # before their kernels — see transformer._attention).
+    # mode, and K/V activations stay at kv_heads width end-to-end through
+    # every kernel (dense einsum broadcasting, grouped flash blocks,
+    # grouped ring/Ulysses) — the only broadcasts left are sharding
+    # fallbacks when a mesh axis cannot divide kv_heads (see
+    # transformer._attention).
     num_kv_heads: int | None = None
     # Causal (decoder) masking; False = bidirectional attention.  The
     # "simplified" reference shortcut has no attention at all and ignores
-    # this; ring attention is causal-only (its skew-schedule assumes it).
+    # this; every real kernel (full/flash/ring/ulysses) supports both.
     causal: bool = True
     # Mixture-of-experts FFN (0 = dense FFN).  num_experts > 0 replaces each
     # block's FFN with moe_top_k-gated experts; experts shard over an
@@ -61,8 +67,8 @@ class ModelConfig:
                 f"hidden_size {self.hidden_size} not divisible by "
                 f"num_heads {self.num_heads}"
             )
-        if self.attention not in ("full", "simplified", "flash", "ring",
-                                  "ulysses"):
+        if self.attention not in ("full", "dense", "simplified", "flash",
+                                  "ring", "ulysses"):
             raise ValueError(f"unknown attention mode {self.attention!r}")
         if self.num_experts < 0:
             raise ValueError(f"num_experts must be >= 0, got {self.num_experts}")
@@ -93,12 +99,6 @@ class ModelConfig:
                     f"num_heads={self.num_heads} not divisible by "
                     f"num_kv_heads={self.num_kv_heads}"
                 )
-        if not self.causal and self.attention == "ring":
-            raise ValueError(
-                "attention='ring' is causal-only (the ring schedule skews "
-                "by rank assuming a causal mask); use 'ulysses', 'full', "
-                "or 'flash' for bidirectional attention"
-            )
 
     @property
     def head_dim(self) -> int:

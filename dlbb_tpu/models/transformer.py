@@ -126,13 +126,10 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
         return t.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
 
     q, k, v = heads(q, n), heads(k, kvh), heads(v, kvh)
-    if kvh != n and config.attention != "full":
-        # flash/ring/ulysses consume plain MHA shapes, so K/V are broadcast
-        # to num_heads before those kernels: their GQA saving is currently
-        # the projection width only.  The dense "full" path keeps K/V at
-        # kv_heads width end-to-end (grouped einsum in dense_attention).
-        k = jnp.repeat(k, n // kvh, axis=1)
-        v = jnp.repeat(v, n // kvh, axis=1)
+    # Grouped K/V flow at kv_heads width end-to-end through every kernel
+    # (dense einsum broadcasting; grouped flash blocks; grouped ring/
+    # Ulysses).  The only broadcasts left are sharding fallbacks where a
+    # mesh axis cannot divide kv_heads — marked below.
 
     if config.attention in ("ring", "ulysses"):
         # sequence/context-parallel attention over the mesh's sp axis
@@ -144,50 +141,95 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
         from dlbb_tpu.parallel import ring_attention, ulysses_attention
 
         if config.attention == "ring":
-            o = ring_attention(q, k, v, mesh, sp_axis=sp_axis)  # causal-only
+            o = ring_attention(q, k, v, mesh, sp_axis=sp_axis,
+                               causal=config.causal)
         else:
+            if kvh != n and kvh % mesh.shape[sp_axis] != 0:
+                # Ulysses all-to-alls the head dim over sp; kv_heads not
+                # divisible by sp cannot stay grouped — broadcast fallback
+                # (ring attention keeps grouped K/V for any kv_heads)
+                k = jnp.repeat(k, n // kvh, axis=1)
+                v = jnp.repeat(v, n // kvh, axis=1)
             o = ulysses_attention(q, k, v, mesh, sp_axis=sp_axis,
                                   causal=config.causal)
     elif config.attention == "flash":
-        from dlbb_tpu.ops import flash_attention
-
-        if mesh is not None and sp_axis in mesh.axis_names and mesh.shape[sp_axis] > 1:
-            raise ValueError(
-                "attention='flash' does not partition the sequence; use "
-                "attention='ring' or 'ulysses' when sequence_parallel > 1"
-            )
-        dp = (
-            "dp" if mesh is not None and "dp" in mesh.axis_names
-            and mesh.shape["dp"] > 1 else None
-        )
-        tp = (
-            "tp" if mesh is not None and "tp" in mesh.axis_names
-            and mesh.shape["tp"] > 1 else None
-        )
-        if dp is not None or tp is not None:
-            # pallas_call is opaque to GSPMD — without an explicit
-            # shard_map, jit would all-gather the batch-(dp) and
-            # head-(tp) sharded qkv and run the kernel replicated on
-            # every device.  Batch entries and heads are independent, so
-            # map the kernel over whichever of (dp, tp) is actually
-            # sharded; each device computes only its own slice.
-            from jax import shard_map
-            from jax.sharding import PartitionSpec as P
-
-            spec = P(dp, tp, None, None)
-            o = shard_map(
-                lambda q, k, v: flash_attention(
-                    q, k, v, causal=config.causal),
-                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False,  # pallas_call declares no vma
-            )(q, k, v)
-        else:
-            o = flash_attention(q, k, v, causal=config.causal)
-    else:
+        o = _flash_dispatch(q, k, v, config, mesh, sp_axis)
+    else:  # "full" (auto-routed exact) | "dense" (forced dense kernel)
         from dlbb_tpu.models.attention import dense_attention
 
-        o = dense_attention(q, k, v, causal=config.causal)
+        sp_sharded = (mesh is not None and sp_axis in mesh.axis_names
+                      and mesh.shape[sp_axis] > 1)
+        if (config.attention == "full" and not sp_sharded
+                and _flash_profitable(q.shape)):
+            # exact numerics either way; the blocked kernel avoids the
+            # [B, N, S, S] score materialisation that throttles (and at
+            # S=8192 OOMs) the dense path
+            o = _flash_dispatch(q, k, v, config, mesh, sp_axis)
+        else:
+            o = dense_attention(q, k, v, causal=config.causal)
     return o.transpose(0, 2, 1, 3).reshape(b, s, n * d)
+
+
+# Route "full" attention through the pallas kernel on real TPUs at
+# sequence lengths where it measurably wins; the simulated/CPU dev mesh
+# keeps the dense einsum (interpret-mode pallas would be pure overhead).
+# Gate calibration (v5e chip, bf16): standalone at S=512 the fused dense
+# einsum still wins (e.g. B8/N16/D128: dense 0.29 ms vs flash 0.41 ms;
+# small shapes up to 6x), while round-2 e2e at S=512 showed flash ahead
+# (1B 159.5 vs 143.4 TFLOP/s) — mixed evidence, so the gate sits at 1024
+# where the S^2 score tensor is decisively hostile (dense OOMs by 8192).
+FLASH_ROUTE_MIN_SEQ = 1024
+
+
+def _flash_profitable(q_shape) -> bool:
+    import jax as _jax
+
+    return (_jax.default_backend() == "tpu"
+            and q_shape[2] >= FLASH_ROUTE_MIN_SEQ)
+
+
+def _flash_dispatch(q, k, v, config: ModelConfig, mesh, sp_axis: str):
+    """Run the pallas flash kernel under the sharding the mesh dictates.
+
+    pallas_call is opaque to GSPMD — without an explicit shard_map, jit
+    would all-gather the batch-(dp) and head-(tp) sharded qkv and run the
+    kernel replicated on every device.  Batch entries and heads are
+    independent, so map the kernel over whichever of (dp, tp) is actually
+    sharded; each device computes only its own slice.
+    """
+    from dlbb_tpu.ops import flash_attention
+
+    n, kvh = q.shape[1], k.shape[1]
+    if mesh is not None and sp_axis in mesh.axis_names and mesh.shape[sp_axis] > 1:
+        raise ValueError(
+            "attention='flash' does not partition the sequence; use "
+            "attention='ring' or 'ulysses' when sequence_parallel > 1"
+        )
+    dp = (
+        "dp" if mesh is not None and "dp" in mesh.axis_names
+        and mesh.shape["dp"] > 1 else None
+    )
+    tp = (
+        "tp" if mesh is not None and "tp" in mesh.axis_names
+        and mesh.shape["tp"] > 1 else None
+    )
+    if dp is not None or tp is not None:
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        if kvh != n and tp is not None and kvh % mesh.shape[tp] != 0:
+            # the head axis is tp-sharded; kv_heads not divisible by
+            # tp cannot stay grouped — broadcast fallback
+            k = jnp.repeat(k, n // kvh, axis=1)
+            v = jnp.repeat(v, n // kvh, axis=1)
+        spec = P(dp, tp, None, None)
+        return shard_map(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=config.causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,  # pallas_call declares no vma
+        )(q, k, v)
+    return flash_attention(q, k, v, causal=config.causal)
 
 
 def router_probs_gates(logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
@@ -337,21 +379,17 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
     microbatched pipeline engine (``dlbb_tpu/parallel/pipeline.py``).
 
     ``with_aux=True`` additionally returns the layer-mean MoE
-    load-balancing loss (``moe_aux_loss``) — unsupported under pipeline
-    parallelism, whose stages do not return per-layer scalars.
+    load-balancing loss (``moe_aux_loss``); under pipeline parallelism it
+    is additionally averaged over microbatches (per-stage masked
+    accumulation + psum — see ``pipeline_forward``).
     """
     if (mesh is not None and pp_axis in mesh.axis_names
             and mesh.shape[pp_axis] > 1):
-        if with_aux:
-            raise ValueError(
-                "with_aux (MoE load-balancing loss) is not supported "
-                "under pipeline parallelism"
-            )
         from dlbb_tpu.parallel.pipeline import pipeline_forward
 
         return pipeline_forward(
             params, x, config, mesh, pp_axis=pp_axis,
-            num_microbatches=num_microbatches,
+            num_microbatches=num_microbatches, with_aux=with_aux,
         )
 
     def body(carry, layer):

@@ -139,7 +139,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
 
 
 def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
-    """q,k,v: [BN, S, D] -> (o [BN, S, D], lse [BN, S, LANES] fp32).
+    """q: [BN, S, D]; k, v: [BKV, Sk, D] with BN % BKV == 0 (grouped-query
+    attention folds kv_heads into BKV; the group size ``g = BN // BKV``
+    makes ``g`` consecutive Q rows of the grid share one K/V row via the
+    ``b // g`` index map — K/V stay at kv_heads width in HBM and VMEM).
+    Returns (o [BN, S, D], lse [BN, S, LANES] fp32).
 
     The row-stat (lse) output carries a broadcast 128-lane axis: TPU vector
     memory is (sublane, lane)-tiled, so a dense [BN, S] layout would be
@@ -148,7 +152,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
     pallas_call) and live only across the backward for training.
     """
     bn, s, d = q.shape
-    sk = k.shape[1]
+    bkv, sk, _ = k.shape
+    g = bn // bkv
     block_q = _fit_block(s, block_q)
     block_k = _fit_block(sk, block_k)
     offset = sk - s
@@ -162,8 +167,8 @@ def _fwd(q, k, v, sm_scale, causal, block_q, block_k, interpret):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -241,12 +246,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc,
-                *, sm_scale, block_q, block_k, causal, offset):
+                *, sm_scale, block_q, block_k, causal, offset, q_blocks):
+    """Accumulates dk, dv for one K/V block.  The inner grid dim flattens
+    (query-head group, Q block) — ``q_blocks`` Q blocks per group — so
+    under grouped-query attention one K/V block accumulates gradient from
+    every query head that shares it."""
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    it = pl.program_id(2)       # flattened (group, q-block) index
+    qi = it % q_blocks          # Q block index within the group
+    nit = pl.num_programs(2)
 
-    @pl.when(qi == 0)
+    @pl.when(it == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -275,7 +285,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )                                                       # [bk, d]
 
-    @pl.when(qi == nq - 1)
+    @pl.when(it == nit - 1)
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
@@ -284,10 +294,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     q, k, v, o, lse = res
     bn, s, d = q.shape
-    sk = k.shape[1]
+    bkv, sk, _ = k.shape
+    g = bn // bkv
     block_q = _fit_block(s, block_q)
     block_k = _fit_block(sk, block_k)
     offset = sk - s
+    nq = s // block_q
 
     delta = jnp.sum(
         o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
@@ -296,7 +308,7 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
     delta = jnp.broadcast_to(delta, (bn, s, _LANES))
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // g, j, 0))
     row_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
 
     dq = pl.pallas_call(
@@ -310,14 +322,21 @@ def _bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    # dkv: swap loop order — K blocks outer, Q blocks inner
-    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    # dkv: swap loop order — K blocks outer; the inner dim flattens
+    # (query-head group, Q block) so each of the bkv K/V rows accumulates
+    # over its g sharing query heads (grid row b serves Q rows b*g..b*g+g-1)
+    q_spec_t = pl.BlockSpec(
+        (1, block_q, d), lambda b, j, i: (b * g + i // nq, i % nq, 0)
+    )
     k_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
-    row_spec_t = pl.BlockSpec((1, block_q, _LANES), lambda b, j, i: (b, i, 0))
+    row_spec_t = pl.BlockSpec(
+        (1, block_q, _LANES), lambda b, j, i: (b * g + i // nq, i % nq, 0)
+    )
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, block_q=block_q,
-                          block_k=block_k, causal=causal, offset=offset),
-        grid=(bn, sk // block_k, s // block_q),
+                          block_k=block_k, causal=causal, offset=offset,
+                          q_blocks=nq),
+        grid=(bkv, sk // block_k, g * nq),
         in_specs=[q_spec_t, k_spec_t, k_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[k_spec_t, k_spec_t],
@@ -359,25 +378,36 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool | None = None,
 ) -> jax.Array:
-    """Blocked attention, ``[B, num_heads, S, head_dim] -> same``.
+    """Blocked attention, ``q: [B, num_heads, S, head_dim] -> same``.
+
+    ``k, v`` may be full ``[B, num_heads, Sk, head_dim]`` or grouped-query
+    ``[B, kv_heads, Sk, head_dim]`` with ``num_heads % kv_heads == 0`` —
+    query-head groups share K/V blocks inside the kernel (``b // g`` index
+    maps), so grouped K/V stay at kv_heads width in HBM and VMEM: the
+    KV-bandwidth saving GQA exists for, not just a smaller projection.
 
     Differentiable (custom VJP with blockwise recompute — no [S, S]
-    residuals).  ``sk != s`` is supported; with ``causal=True`` the diagonal
-    anchors at the end of the key axis (kv-cache decode convention).
-    ``interpret=None`` auto-selects pallas interpret mode off TPU so the
-    same model code runs on the CPU-simulated dev mesh.
+    residuals; dk/dv accumulate over the sharing query heads).  ``sk != s``
+    is supported; with ``causal=True`` the diagonal anchors at the end of
+    the key axis (kv-cache decode convention).  ``interpret=None``
+    auto-selects pallas interpret mode off TPU so the same model code runs
+    on the CPU-simulated dev mesh.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, N, S, D], got {q.shape}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, n, s, d = q.shape
-    sk = k.shape[2]
+    kvh, sk = k.shape[1], k.shape[2]
+    if n % kvh != 0:
+        raise ValueError(
+            f"num_heads {n} not divisible by kv_heads {kvh}"
+        )
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
-    fold = lambda t, sl: t.reshape(b * n, sl, d)  # noqa: E731
+    fold = lambda t, nh, sl: t.reshape(b * nh, sl, d)  # noqa: E731
     o = _flash(
-        fold(q, s), fold(k, sk), fold(v, sk),
+        fold(q, n, s), fold(k, kvh, sk), fold(v, kvh, sk),
         sm_scale, causal, block_q, block_k, interpret,
     )
     return o.reshape(b, n, s, d)

@@ -53,11 +53,12 @@ def validate_pipeline(config: ModelConfig, n_stages: int, batch_size: int,
             f"batch_size={batch_size} not divisible by "
             f"num_microbatches={m}"
         )
-    if config.attention not in ("full", "simplified"):
+    if config.attention not in ("full", "dense", "simplified"):
         raise ValueError(
             f"attention={config.attention!r} cannot run under pipeline "
             "parallelism (ring/ulysses/flash need their own shard_map; "
-            "use attention='full' or 'simplified' with pipeline_parallel > 1)"
+            "use attention='full'/'dense'/'simplified' with "
+            "pipeline_parallel > 1)"
         )
     return m
 
@@ -69,18 +70,34 @@ def pipeline_forward(
     mesh: Mesh,
     pp_axis: str = PP_AXIS,
     num_microbatches: Optional[int] = None,
-) -> jax.Array:
+    with_aux: bool = False,
+):
     """Full-model forward with the layer stack pipelined over ``pp_axis``.
 
     ``params`` must hold the stacked-layer pytree of
     ``models/transformer.py::init_params`` with the leading layer axis
     sharded over ``pp_axis``; the final layernorm runs outside the
     pipeline (replicated, applied after the shard_map).
+
+    ``with_aux=True`` additionally returns the MoE load-balancing loss,
+    averaged over layers AND microbatches: each stage accumulates its
+    local layers' aux for the microbatch it validly processes at each tick
+    (bubble ticks masked out), and a ``psum`` over ``pp_axis`` totals the
+    stages.  Mean-over-microbatches is the same approximation gradient
+    accumulation makes (``moe_aux_loss`` is nonlinear in the batch, so it
+    is not bit-identical to the unpipelined full-batch aux — the standard
+    microbatching semantics).
     """
     from dlbb_tpu.models.transformer import _block, _layernorm
 
     n_stages = mesh.shape[pp_axis]
     m = validate_pipeline(config, n_stages, x.shape[0], num_microbatches)
+    if config.attention == "full":
+        # pin the einsum kernel inside the stage body: the TPU flash
+        # auto-route would drop an opaque pallas_call under the shard_map's
+        # auto dp/tp axes — the exact GSPMD pathology validate_pipeline
+        # rejects attention='flash' for.  Same math either way.
+        config = config.with_(attention="dense")
 
     layer_specs = jax.tree.map(lambda _: P(pp_axis), params["layers"])
 
@@ -90,23 +107,30 @@ def pipeline_forward(
         mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
         state = lax.pcast(jnp.zeros_like(mb[0]), (pp_axis,), to="varying")
         outputs = lax.pcast(jnp.zeros_like(mb), (pp_axis,), to="varying")
+        aux0 = lax.pcast(jnp.zeros((), jnp.float32), (pp_axis,),
+                         to="varying")
 
         def local_fwd(h):
             def body(carry, layer):
-                new_h, _aux = _block(carry, layer, config)
-                return new_h, None
+                new_h, aux = _block(carry, layer, config)
+                return new_h, aux
 
             if config.remat:
                 body = jax.checkpoint(body, prevent_cse=False)
-            h, _ = lax.scan(body, h, layers_local)
-            return h
+            h, auxs = lax.scan(body, h, layers_local)
+            return h, auxs.sum()  # sum over this stage's local layers
 
         def tick(carry, t):
-            state, outputs = carry
+            state, outputs, aux_sum = carry
             inject = lax.dynamic_index_in_dim(
                 mb, jnp.clip(t, 0, m - 1), 0, keepdims=False
             )
-            y = local_fwd(jnp.where(pp == 0, inject, state))
+            y, aux = local_fwd(jnp.where(pp == 0, inject, state))
+            # stage p processes microbatch t - p at tick t; outside
+            # [0, m) it is running on bubble garbage — mask its aux out
+            mb_idx = t - pp
+            valid = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+            aux_sum = aux_sum + jnp.where(valid, aux, 0.0)
             out_t = t - (n_stages - 1)
             write = jnp.logical_and(
                 pp == n_stages - 1,
@@ -120,10 +144,10 @@ def pipeline_forward(
                 y, pp_axis,
                 [(i, (i + 1) % n_stages) for i in range(n_stages)],
             )
-            return (state, outputs), None
+            return (state, outputs, aux_sum), None
 
-        (_, outputs), _ = lax.scan(
-            tick, (state, outputs), jnp.arange(m + n_stages - 1)
+        (_, outputs, aux_sum), _ = lax.scan(
+            tick, (state, outputs, aux0), jnp.arange(m + n_stages - 1)
         )
         # only the last stage holds real outputs; the masked psum is the
         # SPMD broadcast back to every stage
@@ -131,13 +155,18 @@ def pipeline_forward(
             jnp.where(pp == n_stages - 1, outputs, jnp.zeros_like(outputs)),
             pp_axis,
         )
-        return outputs.reshape(x.shape)
+        # stages hold disjoint layer blocks: psum totals all layers x mbs
+        aux_total = lax.psum(aux_sum, pp_axis)
+        return outputs.reshape(x.shape), aux_total
 
-    y = shard_map(
+    y, aux_total = shard_map(
         stage_local,
         mesh=mesh,
         in_specs=(layer_specs, P()),
-        out_specs=P(),
+        out_specs=(P(), P()),
         axis_names={pp_axis},
     )(params["layers"], x)
-    return _layernorm(y, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    out = _layernorm(y, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    if with_aux:
+        return out, aux_total / (config.num_layers * m)
+    return out

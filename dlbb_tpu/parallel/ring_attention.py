@@ -1,4 +1,5 @@
-"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+"""Ring attention: exact attention (causal or bidirectional) over a
+sequence-sharded mesh axis, with grouped-query K/V.
 
 Each device owns one contiguous block of the sequence (queries stay put; key/
 value blocks travel the ring).  At ring step ``j`` a device holds the KV
@@ -11,7 +12,9 @@ transfers, overlapping the DMA with the current block's matmuls.
 
 Causality is enforced through *global* positions (query block index is the
 device's axis rank, key block index is the travelling block's origin), so
-the result is bit-for-bit the causal attention of the unsharded sequence.
+the result is bit-for-bit the causal attention of the unsharded sequence;
+with ``causal=False`` the mask is omitted and every block pair attends —
+bidirectional long context with the same ring schedule.
 
 Memory per device is O(S/P · d + (S/P)²) — the (S/P)² logits tile — versus
 O(S²) for dense attention, which is what makes million-token contexts
@@ -33,20 +36,27 @@ _NEG_INF = -1e30  # finite mask value: avoids exp(-inf + inf) = nan in the
 
 
 def _ring_body(q, k0, v0, axis_name: str, num_blocks: int, causal: bool):
-    """Local computation: q, k0, v0 are this device's blocks [B, n, Sl, d]."""
+    """Local computation: q is this device's block [B, n, Sl, d]; k0, v0 are
+    [B, kv_heads, Sl, d] — kv_heads == n for MHA, a divisor of n for
+    grouped-query attention (query-head groups share K/V heads via einsum
+    broadcasting; K/V stay at kv_heads width both in memory AND on the
+    ring, so GQA shrinks the per-step ppermute payload by n/kv_heads)."""
     b, n, sl, d = q.shape
+    kvh = k0.shape[1]
+    g = n // kvh
     scale = 1.0 / math.sqrt(d)
     my_block = lax.axis_index(axis_name)
     perm = [(i, (i + 1) % num_blocks) for i in range(num_blocks)]
 
-    q32 = q.astype(jnp.float32)
+    # grouped view [B, kvh, g, Sl, d] — g == 1 reduces to plain MHA
+    q32 = q.astype(jnp.float32).reshape(b, kvh, g, sl, d)
     pos_q = my_block * sl + jnp.arange(sl)  # global query positions
 
     def attend(j, k_cur, v_cur, m, l, acc):
         """Accumulate ring-step-j's KV block into the online softmax."""
         src = (my_block - j) % num_blocks  # origin rank of the current KV
         logits = (
-            jnp.einsum("bnqd,bnkd->bnqk", q32, k_cur.astype(jnp.float32))
+            jnp.einsum("bhgqd,bhkd->bhgqk", q32, k_cur.astype(jnp.float32))
             * scale
         )
         if causal:
@@ -58,7 +68,7 @@ def _ring_body(q, k0, v0, axis_name: str, num_blocks: int, causal: bool):
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum(
-            "bnqk,bnkd->bnqd", p, v_cur.astype(jnp.float32)
+            "bhgqk,bhkd->bhgqd", p, v_cur.astype(jnp.float32)
         )
         return m_new, l_new, acc_new
 
@@ -82,7 +92,7 @@ def _ring_body(q, k0, v0, axis_name: str, num_blocks: int, causal: bool):
     )
     m, l, acc = attend(num_blocks - 1, k_last, v_last, m, l, acc)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    return out.reshape(b, n, sl, d).astype(q.dtype)
 
 
 def ring_attention(
@@ -94,11 +104,19 @@ def ring_attention(
     causal: bool = True,
     batch_axes: Sequence[str] = ("dp",),
 ) -> jax.Array:
-    """Exact causal attention with the sequence dim sharded over ``sp_axis``.
+    """Exact attention (causal or bidirectional) with the sequence dim
+    sharded over ``sp_axis``.
 
-    q, k, v: global ``[B, num_heads, S, head_dim]``; S must divide evenly
-    over the ``sp_axis`` mesh size.  Batch may additionally be sharded over
-    ``batch_axes`` (those present in the mesh).
+    q: global ``[B, num_heads, S, head_dim]``; k, v: same, or grouped-query
+    ``[B, kv_heads, S, head_dim]`` with ``num_heads % kv_heads == 0`` —
+    K/V stay at kv_heads width in memory and on the ring.  S must divide
+    evenly over the ``sp_axis`` mesh size.  Batch may additionally be
+    sharded over ``batch_axes`` (those present in the mesh).
+
+    ``causal=False`` attends every query block to every travelling KV
+    block (the per-step mask is simply omitted; the online-softmax
+    recurrence and ring schedule are position-agnostic, so no skew or
+    rank-dependent scheduling is involved).
     """
     if sp_axis not in mesh.axis_names:
         raise ValueError(
@@ -108,6 +126,10 @@ def ring_attention(
     if q.shape[2] % num_blocks != 0:
         raise ValueError(
             f"sequence length {q.shape[2]} not divisible by sp={num_blocks}"
+        )
+    if q.shape[1] % k.shape[1] != 0:
+        raise ValueError(
+            f"num_heads {q.shape[1]} not divisible by kv_heads {k.shape[1]}"
         )
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     spec = P(bspec, None, sp_axis, None)

@@ -30,19 +30,29 @@ def ulysses_attention(
     batch_axes: Sequence[str] = ("dp",),
 ) -> jax.Array:
     """Exact attention (causal or bidirectional) with sequence sharded over
-    ``sp_axis`` via head resharding.  q, k, v: global
-    ``[B, num_heads, S, head_dim]``; ``num_heads`` must be divisible by the
-    ``sp_axis`` mesh size."""
+    ``sp_axis`` via head resharding.  q: global
+    ``[B, num_heads, S, head_dim]``; k, v: same, or grouped-query
+    ``[B, kv_heads, S, head_dim]`` — both head counts must divide by the
+    ``sp_axis`` mesh size (each device then holds ``num_heads/P`` query
+    heads and ``kv_heads/P`` K/V heads after the all-to-all, and the
+    per-group dense kernel shares K/V via einsum broadcasting — the
+    all-to-all payload for K/V shrinks by ``num_heads/kv_heads``)."""
     if sp_axis not in mesh.axis_names:
         raise ValueError(
             f"mesh {mesh.axis_names} has no {sp_axis!r} axis for ulysses"
         )
     p = mesh.shape[sp_axis]
-    num_heads = q.shape[1]
+    num_heads, kv_heads = q.shape[1], k.shape[1]
     if num_heads % p != 0:
         raise ValueError(
             f"ulysses needs num_heads ({num_heads}) divisible by "
             f"sp={p}; use ring attention instead"
+        )
+    if kv_heads % p != 0:
+        raise ValueError(
+            f"ulysses needs kv_heads ({kv_heads}) divisible by sp={p}; "
+            "broadcast K/V to num_heads first, or use ring attention "
+            "(which keeps grouped K/V for any kv_heads)"
         )
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     spec = P(bspec, None, sp_axis, None)

@@ -103,7 +103,7 @@ def compare_1d(
     """Join per (operation, data_size_name, num_ranks); one output row per
     config both corpora cover."""
     own = _rows_1d(own_results_dir)
-    if not own:
+    if not own or not Path(ref_results_root).is_dir():
         return []
     ref_best: dict[tuple, dict] = {}
     for backend_dir in sorted(Path(ref_results_root).iterdir()):
@@ -153,7 +153,7 @@ def compare_3d(
     for "best", because the tuned runs are legitimately the reference's
     best published numbers (SURVEY §2.3)."""
     own = _rows_3d(own_results_dir, "xla_tpu")
-    if not own:
+    if not own or not Path(ref_results_root).is_dir():
         return []
     ref_best: dict[tuple, dict] = {}
     for backend_dir in sorted(Path(ref_results_root).iterdir()):

@@ -51,7 +51,7 @@ from dlbb_tpu.models.transformer import (
     init_params_sharded,
 )
 from dlbb_tpu.utils.config import load_config, save_json
-from dlbb_tpu.utils.metrics import summarize
+from dlbb_tpu.utils.metrics import MetricsCollector, Timer, summarize
 from dlbb_tpu.utils.profiling import annotate, step_annotation
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import resolve_timing_mode, time_fn_chained
@@ -367,11 +367,6 @@ def run_train(
             "training.moe_aux_loss_weight requires a MoE model "
             "(model.num_experts > 0)"
         )
-    if moe_aux_weight > 0.0 and plan.pp > 1:
-        raise ValueError(
-            "training.moe_aux_loss_weight is not supported with "
-            "pipeline_parallel > 1"
-        )
     grad_accum = int(train_cfg.get("gradient_accumulation", 1))
     if grad_accum > 1:
         bs = inp["batch_size"]
@@ -448,13 +443,16 @@ def run_train(
 
     losses = []
     if mode == "per_iter":
-        step_times = []
+        # incremental per-step recording (reference run_mpi.py:147-185's
+        # MetricsCollector/Timer roles): Timer syncs on the loss before
+        # stopping the clock; the collector owns the series + summary
+        metrics = MetricsCollector()
         for i in range(iters):
             with step_annotation("train_step", i):
-                t0 = time.perf_counter()
-                state, loss = jit_step(state, batch, tgt)
-                jax.block_until_ready(loss)
-                step_times.append(time.perf_counter() - t0)
+                with Timer() as t:
+                    state, loss = jit_step(state, batch, tgt)
+                    jax.block_until_ready(loss)
+                metrics.record("step_time_sync_s", t.elapsed)
             losses.append(float(loss))
             if ckpt is not None:
                 ckpt.maybe_save(state)
@@ -462,6 +460,7 @@ def run_train(
             "timing_mode": "per_iter",
             "timing_method": "time.perf_counter() + jax.block_until_ready()",
         }
+        step_times = metrics.series("step_time_sync_s")
     else:
         # optimisation trajectory first (each float(loss) forces completion,
         # so losses are real), then honest chained step timing

@@ -1,20 +1,58 @@
-"""Test fixtures: CPU-simulated 8-device mesh.
+"""Test fixtures: CPU-simulated 8-device mesh (default) or the real TPU
+chip (``DLBB_TPU_TESTS=1``).
 
 The reference tests "multi-node without a cluster" by running N ranks on one
 box under mpirun/torchrun (SURVEY §4).  The JAX analogue is
 ``--xla_force_host_platform_device_count=8``: eight fake CPU devices in one
 process.  Env must be set before jax initialises a backend, hence module
 top-level, before any dlbb_tpu import.
+
+``DLBB_TPU_TESTS=1 pytest tests/ -m tpu`` instead runs the ``tpu``-marked
+subset on the real chip — the compiled-mosaic regression net for the pallas
+kernels (everything else runs them in interpret mode), its log committed
+under ``results/tpu_tests/``.  Selection is enforced here: in TPU mode the
+simulated-mesh tests are skipped (one physical device), and in default mode
+the ``tpu`` tests are.
 """
 
-from dlbb_tpu.utils.simulate import force_cpu_simulation
+import os
 
-force_cpu_simulation(8)
+RUN_TPU_TESTS = os.environ.get("DLBB_TPU_TESTS") == "1"
+
+if not RUN_TPU_TESTS:
+    from dlbb_tpu.utils.simulate import force_cpu_simulation
+
+    force_cpu_simulation(8)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
 from dlbb_tpu.comm import MeshSpec, build_mesh  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: needs a real TPU chip (compiled pallas path); run with "
+        "DLBB_TPU_TESTS=1 pytest -m tpu",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    if RUN_TPU_TESTS:
+        skip = pytest.mark.skip(
+            reason="simulated-mesh test (DLBB_TPU_TESTS=1 runs -m tpu only)"
+        )
+        for item in items:
+            if "tpu" not in item.keywords:
+                item.add_marker(skip)
+    else:
+        skip = pytest.mark.skip(
+            reason="needs the real TPU chip (set DLBB_TPU_TESTS=1)"
+        )
+        for item in items:
+            if "tpu" in item.keywords:
+                item.add_marker(skip)
 
 
 def dense_attention_ref(q, k, v, causal=True):

@@ -56,6 +56,54 @@ def test_ulysses_non_causal_matches_dense(sp_mesh, devices):
     np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
 
 
+def test_ring_non_causal_matches_dense(sp_mesh, devices):
+    """Bidirectional ring attention (mask omitted; same position-agnostic
+    ring schedule) == dense non-causal attention."""
+    q, k, v = _qkv()
+    expected = dense_attention_ref(q, k, v, causal=False)
+    sharding = NamedSharding(sp_mesh, P("dp", None, "sp", None))
+    qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+    out = np.asarray(ring_attention(qs, ks, vs, sp_mesh, causal=False))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+def _gqa_qkv(kvh, seed=3):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (B, N, S, D))
+    k = jax.random.normal(ks[1], (B, kvh, S, D))
+    v = jax.random.normal(ks[2], (B, kvh, S, D))
+    return q, k, v
+
+
+@pytest.mark.parametrize("attn,kvh,causal", [
+    (ring_attention, 4, True),
+    (ring_attention, 2, True),   # kvh=2 < sp=4: ring keeps grouped anyway
+    (ring_attention, 4, False),
+    (ulysses_attention, 4, True),   # kvh == sp — minimum grouped Ulysses
+    (ulysses_attention, 4, False),
+])
+def test_gqa_grouped_matches_repeated_oracle(sp_mesh, attn, kvh, causal,
+                                             devices):
+    """Grouped K/V through ring/Ulysses == the repeated-K/V fp64 oracle;
+    K/V ride the ring / all-to-all at kv_heads width."""
+    q, k, v = _gqa_qkv(kvh)
+    expected = dense_attention_ref(
+        q, np.repeat(np.asarray(k), N // kvh, 1),
+        np.repeat(np.asarray(v), N // kvh, 1), causal=causal,
+    )
+    sharding = NamedSharding(sp_mesh, P("dp", None, "sp", None))
+    qs, ks, vs = (jax.device_put(t, sharding) for t in (q, k, v))
+    out = np.asarray(attn(qs, ks, vs, sp_mesh, causal=causal))
+    np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_kv_head_divisibility(sp_mesh, devices):
+    q, _, _ = _gqa_qkv(2)
+    k = v = jnp.zeros((B, 2, S, D))  # kv_heads=2 < sp=4
+    with pytest.raises(ValueError, match="kv_heads"):
+        ulysses_attention(q, k, v, sp_mesh)
+
+
 def test_ring_attention_jits_inside_jit(sp_mesh, devices):
     q, k, v = _qkv()
     sharding = NamedSharding(sp_mesh, P("dp", None, "sp", None))
@@ -83,6 +131,47 @@ def test_model_forward_context_parallel(sp_mesh, devices, mode):
     y_ref = forward(params, x, cfg)
 
     cfg_sp = cfg.with_(attention=mode)
+    xs = jax.device_put(x, NamedSharding(sp_mesh, P("dp", "sp", None)))
+    y_sp = jax.jit(
+        lambda p, a: forward(p, a, cfg_sp, mesh=sp_mesh)
+    )(params, xs)
+    np.testing.assert_allclose(
+        np.asarray(y_ref), np.asarray(y_sp), rtol=2e-3, atol=2e-3
+    )
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_model_forward_gqa_context_parallel(sp_mesh, devices, mode):
+    """Model-level GQA (num_kv_heads=2) through ring/Ulysses on the
+    (dp, sp) mesh == the single-device full-attention GQA model.
+    sp=4 does not divide kv_heads=2, so Ulysses exercises its documented
+    broadcast fallback while ring stays grouped."""
+    cfg = ModelConfig(hidden_size=64, num_layers=2, num_heads=4,
+                      num_kv_heads=2, ffn_intermediate=128,
+                      attention="full", dtype="float32")
+    params = init_params(cfg, jax.random.key(3))
+    x = jax.random.normal(jax.random.key(4), (2, 32, 64), dtype=jnp.float32)
+    y_ref = forward(params, x, cfg)
+    cfg_sp = cfg.with_(attention=mode)
+    xs = jax.device_put(x, NamedSharding(sp_mesh, P("dp", "sp", None)))
+    y_sp = jax.jit(
+        lambda p, a: forward(p, a, cfg_sp, mesh=sp_mesh)
+    )(params, xs)
+    np.testing.assert_allclose(
+        np.asarray(y_ref), np.asarray(y_sp), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_model_forward_ring_non_causal(sp_mesh, devices):
+    """causal=False end-to-end through the model's ring path (the config
+    restriction that rejected this combination is gone)."""
+    cfg = ModelConfig(hidden_size=64, num_layers=2, num_heads=4,
+                      causal=False, ffn_intermediate=128,
+                      attention="full", dtype="float32")
+    params = init_params(cfg, jax.random.key(5))
+    x = jax.random.normal(jax.random.key(6), (2, 32, 64), dtype=jnp.float32)
+    y_ref = forward(params, x, cfg)
+    cfg_sp = cfg.with_(attention="ring")
     xs = jax.device_put(x, NamedSharding(sp_mesh, P("dp", "sp", None)))
     y_sp = jax.jit(
         lambda p, a: forward(p, a, cfg_sp, mesh=sp_mesh)

@@ -113,9 +113,15 @@ def test_non_causal_attention():
     np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
 
-def test_ring_non_causal_rejected():
-    with pytest.raises(ValueError, match="causal-only"):
-        TINY.with_(attention="ring", causal=False)
+def test_ring_non_causal_accepted():
+    """Bidirectional ring attention is a supported combination (oracle
+    parity in tests/test_context_parallel.py); 'dense' is the explicit
+    always-einsum mode and unknown modes still reject."""
+    cfg = TINY.with_(attention="ring", causal=False)
+    assert not cfg.causal
+    assert TINY.with_(attention="dense").attention == "dense"
+    with pytest.raises(ValueError, match="unknown attention"):
+        TINY.with_(attention="sparse")
 
 
 @pytest.mark.parametrize("attention", ["full", "simplified", "flash"])

@@ -118,6 +118,46 @@ def test_moe_pipeline_forward(devices):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_moe_pipeline_with_aux(devices):
+    """with_aux under pp: the pipelined aux (per-stage masked accumulation
+    + psum, averaged over layers x microbatches) equals the mean of the
+    per-microbatch unpipelined auxes — and equals the unpipelined
+    full-batch aux when every microbatch routes identically (the fixed
+    test batch at m=1)."""
+    moe = TINY.with_(num_experts=4, moe_top_k=2)
+    params = init_params(moe, jax.random.key(0))
+    x = _x()
+    mesh = build_mesh(MeshSpec.grid((2, 2), ("pp", "ep")))
+    params_s = shard_params(params, mesh)
+
+    # m == batch-size 8 microbatches of 1 row: oracle = mean over rows
+    y_pp, aux_pp = jax.jit(
+        lambda p, a: forward(p, a, moe, mesh=mesh, num_microbatches=8,
+                             with_aux=True)
+    )(params_s, x)
+    per_row = [
+        float(forward(params, x[i:i + 1], moe, with_aux=True)[1])
+        for i in range(8)
+    ]
+    np.testing.assert_allclose(float(aux_pp), np.mean(per_row),
+                               rtol=1e-5, atol=1e-6)
+    y_ref, _ = forward(params, x, moe, with_aux=True)
+    np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_pp),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_moe_pipeline_train_with_aux_weight(devices):
+    """MoE + pipeline + load-balancing loss trains end-to-end (the
+    combination previously raised)."""
+    cfg = _train_config(pp=2)
+    cfg["model"].update(num_experts=4, moe_top_k=2)
+    cfg["training"]["moe_aux_loss_weight"] = 0.01
+    r = run_train(cfg, verbose=False)
+    assert r["mesh"]["pp"] == 2
+    assert all(np.isfinite(r["losses"]))
+    assert r["losses"][-1] < r["losses"][0]
+
+
 def test_microbatches_without_pp_rejected(devices):
     """num_microbatches without pipeline_parallel must error, not be
     silently ignored."""
