@@ -99,14 +99,16 @@ def _cpu_baseline() -> dict:
     return result
 
 
-def _e2e(size: str, attention: str, iters: int = 10) -> dict:
+def _e2e(size: str, attention: str, iters: int = 10,
+         seq: int = E2E_SEQ) -> dict:
     from dlbb_tpu.bench.e2e import run_e2e
 
     config = {
-        "experiment": {"name": f"bench_{size.lower()}_{attention}_world1"},
+        "experiment": {"name": f"bench_{size.lower()}_{attention}_s{seq}"
+                               "_world1"},
         "model": {"size": size, "attention": attention},
         "parallelism": {"world_size": 1, "data_parallel": 1},
-        "input": {"batch_size": E2E_BATCH, "sequence_length": E2E_SEQ,
+        "input": {"batch_size": E2E_BATCH, "sequence_length": seq,
                   "seed": 42},
         "execution": {"warmup_iterations": 3, "benchmark_iterations": iters},
     }
@@ -130,16 +132,20 @@ def bench_e2e_single_chip() -> dict:
         "vs_baseline": round(tps / baseline["tokens_per_second"], 3),
     }
     # secondary lines: the flagship 7B config and the real-attention 1B
-    # paths.  "full" auto-routes to the flash kernel on TPU at bench
-    # shapes; "dense" pins the einsum kernel so the routing win stays
-    # visible side-by-side.
+    # paths at the reference's S=512, plus a full-vs-dense pair at S=1024
+    # where the flash auto-route fires (FLASH_ROUTE_MIN_SEQ) so the
+    # routing win is measured, not assumed.
     extras = {}
-    for size, attention in (("7B", "simplified"), ("7B", "full"),
-                            ("1B", "full"), ("1B", "flash"),
-                            ("1B", "dense")):
+    for size, attention, seq in (
+        ("7B", "simplified", E2E_SEQ), ("7B", "full", E2E_SEQ),
+        ("1B", "full", E2E_SEQ), ("1B", "flash", E2E_SEQ),
+        ("1B", "full", 1024), ("1B", "dense", 1024),
+    ):
         try:
-            r = _e2e(size, attention, iters=10)
-            extras[f"{size}_{attention}"] = {
+            r = _e2e(size, attention, iters=10, seq=seq)
+            key = (f"{size}_{attention}" if seq == E2E_SEQ
+                   else f"{size}_{attention}_s{seq}")
+            extras[key] = {
                 "tokens_per_second": round(r["tokens_per_second"], 1),
                 "achieved_tflops_per_second":
                     round(r["achieved_tflops_per_second"], 2),

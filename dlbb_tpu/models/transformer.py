@@ -184,8 +184,12 @@ FLASH_ROUTE_MIN_SEQ = 1024
 def _flash_profitable(q_shape) -> bool:
     import jax as _jax
 
+    # lane-aligned sequence required: _fit_block falls back to the largest
+    # divisor, and an unfriendly S (e.g. prime) would degrade the grid to
+    # tiny blocks — far slower than the dense einsum being replaced
     return (_jax.default_backend() == "tpu"
-            and q_shape[2] >= FLASH_ROUTE_MIN_SEQ)
+            and q_shape[2] >= FLASH_ROUTE_MIN_SEQ
+            and q_shape[2] % 128 == 0)
 
 
 def _flash_dispatch(q, k, v, config: ModelConfig, mesh, sp_axis: str):

@@ -51,7 +51,7 @@ from dlbb_tpu.models.transformer import (
     init_params_sharded,
 )
 from dlbb_tpu.utils.config import load_config, save_json
-from dlbb_tpu.utils.metrics import MetricsCollector, Timer, summarize
+from dlbb_tpu.utils.metrics import Timer, summarize
 from dlbb_tpu.utils.profiling import annotate, step_annotation
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import resolve_timing_mode, time_fn_chained
@@ -199,11 +199,15 @@ def make_train_step(
     num_microbatches: Optional[int] = None,
     moe_aux_weight: float = 0.0,
     grad_accum: int = 1,
+    pipeline_schedule: str = "gpipe",
 ):
     """Build (jitted step fn, initial sharded TrainState) for the given
     ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP).
     A mesh with a >1-sized ``pp`` axis makes the inner forward pipelined
     (``num_microbatches`` microbatches, default one per stage);
+    ``pipeline_schedule`` picks the training schedule there — "gpipe"
+    (autodiff through the forward pipeline) or "1f1b" (interleaved
+    backward, activation live-range O(pp) — ``parallel/pipeline.py``);
     ``moe_aux_weight`` adds the MoE load-balancing loss; ``grad_accum``
     splits the batch into that many sequential micro-steps whose mean
     gradient feeds one optimizer update (same numerics as the full batch
@@ -214,6 +218,17 @@ def make_train_step(
     ``params`` pytree as consumed once the first step has run."""
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+    if pipeline_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(
+            f"unknown pipeline_schedule {pipeline_schedule!r} "
+            "(expected 'gpipe' or '1f1b')"
+        )
+    pp_size = mesh.shape.get("pp", 1)
+    if pipeline_schedule == "1f1b" and pp_size <= 1:
+        raise ValueError(
+            "pipeline_schedule='1f1b' requires parallelism.pipeline_parallel"
+            " > 1 (it is a pipeline training schedule)"
+        )
     stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
     base_specs = specs_for_mesh(mesh, moe=config.is_moe)
@@ -239,12 +254,25 @@ def make_train_step(
         lambda s: NamedSharding(mesh, s), dp_specs, is_leaf=_is_spec
     )
 
-    def loss_and_grads(params, batch, targets):
-        if grad_accum == 1:
+    if pipeline_schedule == "1f1b":
+        from dlbb_tpu.parallel.pipeline import pipeline_1f1b_grads
+
+        def value_and_grads(params, batch, targets):
+            return pipeline_1f1b_grads(
+                params, batch, targets, config, mesh,
+                num_microbatches=num_microbatches,
+                moe_aux_weight=moe_aux_weight,
+            )
+    else:
+        def value_and_grads(params, batch, targets):
             return jax.value_and_grad(mse_loss)(
                 params, batch, targets, config, mesh, num_microbatches,
                 moe_aux_weight,
             )
+
+    def loss_and_grads(params, batch, targets):
+        if grad_accum == 1:
+            return value_and_grads(params, batch, targets)
         b = batch.shape[0]
         if b % grad_accum != 0:
             raise ValueError(
@@ -279,10 +307,7 @@ def make_train_step(
         def acc(carry, xs):
             loss_sum, g_sum = carry
             x, t = xs
-            loss, g = jax.value_and_grad(mse_loss)(
-                params, x, t, config, mesh, num_microbatches,
-                moe_aux_weight,
-            )
+            loss, g = value_and_grads(params, x, t)
             if stage >= 2:
                 # keep every micro-step's grads (and thus the carry) in
                 # the dp-sharded layout, so accumulation never materialises
@@ -390,13 +415,14 @@ def run_train(
     optimizer = build_optimizer(train_cfg)
     opt_name, sched_name = resolve_names(train_cfg)
 
+    pipeline_schedule = str(train_cfg.get("pipeline_schedule", "gpipe"))
     params = init_params_sharded(
         model_cfg, jax.random.key(inp.get("seed", 42)), mesh
     )
     jit_step, state = make_train_step(
         model_cfg, mesh, optimizer, params, zero_stage=stage,
         num_microbatches=num_microbatches, moe_aux_weight=moe_aux_weight,
-        grad_accum=grad_accum,
+        grad_accum=grad_accum, pipeline_schedule=pipeline_schedule,
     )
 
     # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see
@@ -443,16 +469,13 @@ def run_train(
 
     losses = []
     if mode == "per_iter":
-        # incremental per-step recording (reference run_mpi.py:147-185's
-        # MetricsCollector/Timer roles): Timer syncs on the loss before
-        # stopping the clock; the collector owns the series + summary
-        metrics = MetricsCollector()
+        step_times = []
         for i in range(iters):
             with step_annotation("train_step", i):
                 with Timer() as t:
                     state, loss = jit_step(state, batch, tgt)
                     jax.block_until_ready(loss)
-                metrics.record("step_time_sync_s", t.elapsed)
+                step_times.append(t.elapsed)
             losses.append(float(loss))
             if ckpt is not None:
                 ckpt.maybe_save(state)
@@ -460,7 +483,6 @@ def run_train(
             "timing_mode": "per_iter",
             "timing_method": "time.perf_counter() + jax.block_until_ready()",
         }
-        step_times = metrics.series("step_time_sync_s")
     else:
         # optimisation trajectory first (each float(loss) forces completion,
         # so losses are real), then honest chained step timing
@@ -509,6 +531,7 @@ def run_train(
         "optimizer": opt_name,
         "schedule": sched_name,
         "gradient_accumulation": grad_accum,
+        "pipeline_schedule": pipeline_schedule if plan.pp > 1 else None,
         "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),

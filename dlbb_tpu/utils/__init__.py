@@ -2,11 +2,10 @@
 IO, system info)."""
 
 from dlbb_tpu.utils.config import load_config, save_json
-from dlbb_tpu.utils.metrics import MetricsCollector, Timer, summarize
+from dlbb_tpu.utils.metrics import Timer, summarize
 from dlbb_tpu.utils.sysinfo import collect_system_info
 
 __all__ = [
-    "MetricsCollector",
     "Timer",
     "summarize",
     "load_config",

@@ -1,11 +1,19 @@
-"""Metrics collection and timing.
+"""Metrics summaries and timing.
 
-Parity with reference ``utils.py:17-87``: ``MetricsCollector`` (named timing
-series → summary with mean/std/min/max/median/p95/p99) and a ``Timer`` context
-manager — but timing here is ``time.perf_counter`` bracketed by
-``jax.block_until_ready``, because under XLA's async dispatch a wall-clock
-timer without a device sync measures dispatch latency, not execution
-(SURVEY §7 "hard parts").
+Parity with reference ``utils.py:17-87``, collapsed to what the harnesses
+actually consume: ``summarize`` (the reference ``MetricsCollector.summary``'s
+mean/std/min/max/median/p95/p99 math, applied by every harness to its timing
+series) and a ``Timer`` context manager — timing here is
+``time.perf_counter`` with an optional ``jax.block_until_ready`` sync,
+because under XLA's async dispatch a wall-clock timer without a device sync
+measures dispatch latency, not execution (SURVEY §7 "hard parts").
+
+The reference's stateful named-series ``MetricsCollector`` object is
+deliberately NOT reproduced: in this design each harness owns its timing
+list and calls ``summarize`` once, so a collector would be a write-then-
+read-back indirection (the reference itself leaves half its ``utils.py``
+helpers unused — ``run_experiment``, ``gather_metrics_from_all_ranks``,
+``utils.py:172-244`` — a known quirk SURVEY §7 says not to replicate).
 """
 
 from __future__ import annotations
@@ -39,29 +47,6 @@ def summarize(values: list[float]) -> dict[str, float]:
         "p99": float(np.percentile(arr, 99)),
         "count": int(arr.size),
     }
-
-
-class MetricsCollector:
-    """Named timing series with summaries (reference ``utils.py:17-70``)."""
-
-    def __init__(self) -> None:
-        self._series: dict[str, list[float]] = {}
-        self._scalars: dict[str, Any] = {}
-
-    def record(self, name: str, value: float) -> None:
-        self._series.setdefault(name, []).append(float(value))
-
-    def record_scalar(self, name: str, value: Any) -> None:
-        self._scalars[name] = value
-
-    def series(self, name: str) -> list[float]:
-        return list(self._series.get(name, []))
-
-    def summary(self) -> dict[str, Any]:
-        out: dict[str, Any] = dict(self._scalars)
-        for name, vals in self._series.items():
-            out[name] = summarize(vals)
-        return out
 
 
 class Timer:

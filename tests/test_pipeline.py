@@ -158,6 +158,105 @@ def test_moe_pipeline_train_with_aux_weight(devices):
     assert r["losses"][-1] < r["losses"][0]
 
 
+def test_1f1b_schedule_invariants():
+    """The wavefront schedule: one-pair producer->consumer lag for both
+    hops, every microbatch forwarded and backwarded exactly once per
+    stage, and in-flight microbatches bounded by 2P-1 (the O(pp)
+    activation live-range, independent of m)."""
+    from dlbb_tpu.parallel.pipeline import schedule_1f1b
+
+    for P, m in ((2, 4), (4, 8), (4, 4), (2, 2), (4, 2)):
+        pairs, fwd, bwd = schedule_1f1b(P, m)
+        assert pairs == m + 2 * (P - 1)
+        for i in range(P):
+            f_u = {int(fwd[u, i]): u for u in range(pairs)
+                   if 0 <= fwd[u, i] < m}
+            b_u = {int(bwd[u, i]): u for u in range(pairs)
+                   if 0 <= bwd[u, i] < m}
+            assert sorted(f_u) == sorted(b_u) == list(range(m))
+            for q in range(m):
+                # forward at or before backward (the last stage runs both
+                # in one pair: the body's F part precedes its B part)
+                assert f_u[q] <= b_u[q]
+                if i > 0:  # activation produced one pair earlier upstream
+                    f_up = {int(fwd[u, i - 1]): u for u in range(pairs)
+                            if 0 <= fwd[u, i - 1] < m}
+                    assert f_u[q] == f_up[q] + 1
+                if i < P - 1:  # cotangent produced one pair earlier below
+                    b_dn = {int(bwd[u, i + 1]): u for u in range(pairs)
+                            if 0 <= bwd[u, i + 1] < m}
+                    assert b_u[q] == b_dn[q] + 1
+            inflight = max(
+                sum(1 for q in range(m) if f_u[q] <= u < b_u[q])
+                for u in range(pairs)
+            )
+            assert inflight <= 2 * P - 1
+
+
+def test_1f1b_grads_match_unpipelined(devices):
+    """pipeline_1f1b_grads == jax.value_and_grad of the unpipelined loss
+    (same math; recompute-based backward; fp accumulation order differs)."""
+    from dlbb_tpu.parallel.pipeline import pipeline_1f1b_grads
+    from dlbb_tpu.train.loop import mse_loss
+
+    params = init_params(TINY, jax.random.key(0))
+    x, t = _x(seed=1), _x(seed=2)
+    loss_ref, grads_ref = jax.value_and_grad(mse_loss)(params, x, t, TINY)
+
+    mesh = build_mesh(MeshSpec.grid((4,), ("pp",)))
+    ps = shard_params(params, mesh)
+    loss_pp, grads_pp = jax.jit(
+        lambda p, a, b: pipeline_1f1b_grads(p, a, b, TINY, mesh,
+                                            num_microbatches=8)
+    )(ps, x, t)
+    np.testing.assert_allclose(float(loss_ref), float(loss_pp), rtol=1e-6)
+    for (ka, ga), (kb, gb) in zip(
+        jax.tree_util.tree_leaves_with_path(grads_ref),
+        jax.tree_util.tree_leaves_with_path(grads_pp),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(ga), np.asarray(gb), rtol=1e-4, atol=1e-6,
+            err_msg=str(ka),
+        )
+
+
+def test_1f1b_train_matches_gpipe(devices):
+    """training.pipeline_schedule='1f1b' follows the same optimisation
+    trajectory as GPipe autodiff and the unpipelined step."""
+    r_plain = run_train(_train_config(pp=1), verbose=False)
+    cfg = _train_config(pp=2)
+    cfg["training"]["pipeline_schedule"] = "1f1b"
+    r_1f1b = run_train(cfg, verbose=False)
+    assert r_1f1b["pipeline_schedule"] == "1f1b"
+    np.testing.assert_allclose(
+        r_plain["losses"], r_1f1b["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_1f1b_moe_aux_matches_gpipe(devices):
+    """MoE + aux loss under 1F1B == the GPipe with_aux path (same
+    per-microbatch aux averaging)."""
+    base = _train_config(pp=2)
+    base["model"].update(num_experts=4, moe_top_k=2)
+    base["training"]["moe_aux_loss_weight"] = 0.01
+    r_gpipe = run_train(base, verbose=False)
+    cfg = _train_config(pp=2)
+    cfg["model"].update(num_experts=4, moe_top_k=2)
+    cfg["training"]["moe_aux_loss_weight"] = 0.01
+    cfg["training"]["pipeline_schedule"] = "1f1b"
+    r_1f1b = run_train(cfg, verbose=False)
+    np.testing.assert_allclose(
+        r_gpipe["losses"], r_1f1b["losses"], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_1f1b_without_pp_rejected(devices):
+    cfg = _train_config(pp=1)
+    cfg["training"]["pipeline_schedule"] = "1f1b"
+    with pytest.raises(ValueError, match="pipeline_parallel"):
+        run_train(cfg, verbose=False)
+
+
 def test_microbatches_without_pp_rejected(devices):
     """num_microbatches without pipeline_parallel must error, not be
     silently ignored."""

@@ -140,6 +140,17 @@ def test_full_attention_routes_to_flash_on_tpu():
     x = jax.random.normal(
         jax.random.key(1), (1, FLASH_ROUTE_MIN_SEQ, 256), jnp.float32
     )
+    # the routing must actually fire: the pallas kernel lowers to a
+    # tpu_custom_call, which the dense einsum path never emits (guards
+    # against the gate silently regressing to dense-vs-dense)
+    hlo_full = jax.jit(
+        lambda p, a: forward(p, a, cfg_full)
+    ).lower(params, x).compile().as_text()
+    hlo_dense = jax.jit(
+        lambda p, a: forward(p, a, cfg_dense)
+    ).lower(params, x).compile().as_text()
+    assert "custom-call" in hlo_full, "full did not route to the kernel"
+    assert "custom-call" not in hlo_dense
     out_full = jax.jit(lambda p, a: forward(p, a, cfg_full))(params, x)
     out_dense = jax.jit(lambda p, a: forward(p, a, cfg_dense))(params, x)
     np.testing.assert_allclose(
