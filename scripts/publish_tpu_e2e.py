@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Publish the real-TPU-chip E2E artifact set under ``results/e2e/``.
+
+The CPU-simulated corpus (``scripts/publish_baselines.py``) covers the
+collective sweeps; this script covers the part only the real chip can
+measure — the E2E TP-forward benchmark (reference ``run_mpi.py`` semantics)
+on the headline model configs.  Run WITHOUT ``--simulate`` on the TPU image:
+the artifacts record the one v5e chip (world_size=1; multi-chip TP numbers
+require a pod and are covered by the dryrun + simulated corpus instead).
+
+Configs mirror ``bench.py``'s headline + extras set so the committed
+artifacts substantiate the BENCH_r*.json lines:
+
+- 1B  x {simplified, full, flash}  @ S=512
+- 7B  x {simplified, full}         @ S=512
+- 1B  x {full, dense}              @ S=1024  (flash auto-route pair)
+
+Usage: python scripts/publish_tpu_e2e.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+CONFIGS = (
+    ("1B", "simplified", 512),
+    ("1B", "full", 512),
+    ("1B", "flash", 512),
+    ("7B", "simplified", 512),
+    ("7B", "full", 512),
+    ("1B", "full", 1024),
+    ("1B", "dense", 1024),
+)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--output", default=str(REPO / "results" / "e2e"))
+    args = ap.parse_args()
+
+    import jax
+
+    devices = jax.devices()
+    print(f"devices: {devices}", flush=True)
+    if devices[0].platform not in ("tpu", "axon"):
+        print("warning: not a TPU backend — artifacts will say so "
+              f"(platform={devices[0].platform})", flush=True)
+
+    from dlbb_tpu.bench.e2e import run_e2e
+
+    failures = []
+    for size, attention, seq in CONFIGS:
+        config = {
+            "experiment": {
+                "name": f"{size.lower()}_{attention}_s{seq}_world1",
+            },
+            "model": {"size": size, "attention": attention},
+            "parallelism": {"world_size": 1, "data_parallel": 1},
+            "input": {"batch_size": 8, "sequence_length": seq, "seed": 42},
+            "execution": {"warmup_iterations": 3,
+                          "benchmark_iterations": args.iters},
+        }
+        try:
+            run_e2e(config, output_dir=args.output)
+        except Exception as e:  # noqa: BLE001 — per-config resilience
+            print(f"FAILED {size}/{attention}/s{seq}: {e}", flush=True)
+            failures.append((size, attention, seq))
+    if failures:
+        print(f"{len(failures)} config(s) failed: {failures}", flush=True)
+        return 1
+    print(f"artifacts in {args.output}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
