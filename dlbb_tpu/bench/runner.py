@@ -111,6 +111,10 @@ class Sweep1D:
     # skip configs whose estimated global input+output footprint exceeds
     # this (host-simulated meshes hold every shard in one RAM pool)
     max_global_bytes: Optional[int] = None
+    # skip configs whose result JSON already exists in output_dir — lets an
+    # interrupted sweep (time-budgeted publisher runs) pick up where it left
+    # off instead of re-measuring the whole grid
+    resume: bool = False
 
     kind: str = "1d"
 
@@ -134,6 +138,7 @@ class Sweep3D:
     timing_mode: str = "auto"
     max_config_seconds: Optional[float] = None
     max_global_bytes: Optional[int] = None
+    resume: bool = False
 
     kind: str = "3d"
 
@@ -240,6 +245,15 @@ def run_sweep(
                             f"> cap {sweep.max_global_bytes / 2**30:.1f} GiB"
                         )
                     continue
+            if sweep.resume:
+                existing = out_dir / _result_filename(
+                    sweep, impl, num_ranks, config
+                )
+                if _resume_exists(existing):
+                    if verbose:
+                        print(f"  [resume-skip] {existing.name}")
+                    written.append(existing)
+                    continue
             try:
                 path = _run_one(
                     sweep, variant, impl, mesh, axes, num_ranks, config,
@@ -283,6 +297,36 @@ def _iter_configs(sweep):
                             "seq_len": s,
                             "hidden_dim": h,
                         }
+
+
+def _resume_exists(path: Path) -> bool:
+    """Whether a resume-mode sweep may skip this config.
+
+    Multi-host runs decide collectively: hosts have non-shared disks, and a
+    run killed between one host's ``save_json`` and another's would leave
+    them disagreeing — a per-host decision would send some hosts into the
+    config's SPMD collective while others skip it, hanging the pod.  Every
+    process calls this for every candidate config in the same order, so the
+    allgather schedule stays uniform; the config re-runs everywhere unless
+    ALL hosts already hold the artifact (re-measuring on the hosts that had
+    it just atomically overwrites)."""
+    exists = path.exists()
+    if jax.process_count() == 1:
+        return exists
+    from jax.experimental import multihost_utils
+
+    bits = multihost_utils.process_allgather(
+        np.asarray([exists], dtype=np.int32)
+    )
+    return bool(np.asarray(bits).all())
+
+
+def _result_filename(sweep, impl: str, num_ranks: int, config) -> str:
+    op_name = config["operation"]
+    if sweep.kind == "1d":
+        return f"{impl}_{op_name}_ranks{num_ranks}_{config['size_label']}.json"
+    b, s, h = config["batch"], config["seq_len"], config["hidden_dim"]
+    return f"{impl}_{op_name}_ranks{num_ranks}_b{b}_s{s}_h{h}.json"
 
 
 def _run_one(
@@ -342,9 +386,7 @@ def _run_one(
     }
 
     if sweep.kind == "1d":
-        label = config["size_label"]
-        result["data_size_name"] = label
-        fname = f"{impl}_{op_name}_ranks{num_ranks}_{label}.json"
+        result["data_size_name"] = config["size_label"]
     else:
         b, s, h = config["batch"], config["seq_len"], config["hidden_dim"]
         tensor_size_bytes = num_elements * 2  # reported as-bf16, like the
@@ -352,8 +394,8 @@ def _run_one(
         result["tensor_shape"] = {"batch": b, "seq_len": s, "hidden_dim": h}
         result["tensor_size_bytes"] = tensor_size_bytes
         result["tensor_size_mb"] = tensor_size_bytes / 2**20
-        fname = f"{impl}_{op_name}_ranks{num_ranks}_b{b}_s{s}_h{h}.json"
 
+    fname = _result_filename(sweep, impl, num_ranks, config)
     path = save_json(result, out_dir / fname)
     if verbose:
         mean_ms = float(np.mean(timings)) * 1e3

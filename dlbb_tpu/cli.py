@@ -28,6 +28,9 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--output", default=None, help="output directory for result JSONs")
     p.add_argument("--simulate", type=int, default=0, metavar="N",
                    help="use an N-device CPU-simulated mesh (dev path)")
+    p.add_argument("--resume", action="store_true",
+                   help="skip configs whose result JSON already exists in the "
+                        "output dir (pick an interrupted sweep back up)")
     _add_trace(p)
 
 
@@ -173,9 +176,11 @@ def _dispatch(args) -> int:
             warmup_iterations=args.warmup,
             measurement_iterations=args.iters,
             output_dir=args.output or "results/1d",
+            resume=args.resume,
         )
         files = run_sweep(sweep)
-        print(f"wrote {len(files)} result files to {sweep.output_dir}")
+        # resume mode counts pre-existing artifacts too — don't claim writes
+        print(f"{len(files)} result artifacts in {sweep.output_dir}")
         return 0
 
     if args.cmd == "bench3d":
@@ -193,9 +198,10 @@ def _dispatch(args) -> int:
             warmup_iterations=args.warmup,
             measurement_iterations=args.iters,
             output_dir=args.output or "results/3d",
+            resume=args.resume,
         )
         files = run_sweep(sweep)
-        print(f"wrote {len(files)} result files to {sweep.output_dir}")
+        print(f"{len(files)} result artifacts in {sweep.output_dir}")
         return 0
 
     if args.cmd == "stats1d":

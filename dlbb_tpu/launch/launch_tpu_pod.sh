@@ -13,14 +13,56 @@
 #   ./launch_tpu_pod.sh bench3d --ranks 16
 #   ./launch_tpu_pod.sh e2e --config dlbb_tpu/configs/baseline_config.yaml
 #
-# Tuning variants that carry XLA flags (see dlbb_tpu/comm/variants.py) must
-# have them set at process start; pass VARIANT_XLA_FLAGS:
-#   VARIANT_XLA_FLAGS="--xla_tpu_all_reduce_combine_threshold_bytes=4194304" \
-#     ./launch_tpu_pod.sh bench1d --variant combine4mb ...
+# Tuning variants that carry XLA flags (dlbb_tpu/comm/variants.py, e.g.
+# combine4mb / combine128mb — the CCL_FUSION_BYTES_THRESHOLD analogue) need
+# them in XLA_FLAGS before process start.  The launcher resolves them from
+# the --variant name automatically; VARIANT_XLA_FLAGS remains available as a
+# manual override for ad-hoc flag experiments:
+#   VARIANT_XLA_FLAGS="--xla_tpu_all_reduce_combine_threshold_bytes=1048576" \
+#     ./launch_tpu_pod.sh bench1d ...
+#
+# DLBB_LAUNCH_DRYRUN=1 prints the resolved environment + command instead of
+# exec'ing — used by tests/test_launch.py to pin the flag-injection contract
+# without a pod.
 
 set -euo pipefail
 
-export XLA_FLAGS="${XLA_FLAGS:-} ${VARIANT_XLA_FLAGS:-}"
+# Resolve --variant <name> (both "--variant name" and "--variant=name",
+# matching what dlbb_tpu.cli's argparse accepts) from the arguments.
+VARIANT=""
+prev=""
+for arg in "$@"; do
+  if [ "$prev" = "--variant" ]; then
+    VARIANT="$arg"
+  fi
+  case "$arg" in
+    --variant=*) VARIANT="${arg#--variant=}" ;;
+  esac
+  prev="$arg"
+done
+
+RESOLVED_FLAGS=""
+if [ -n "$VARIANT" ]; then
+  # Ask the variant registry for process-start XLA flags.  JAX_PLATFORMS=cpu
+  # keeps the helper import from touching the TPU runtime before the real
+  # process starts.
+  RESOLVED_FLAGS=$(JAX_PLATFORMS=cpu python - "$VARIANT" <<'PYEOF'
+import sys
+from dlbb_tpu.comm.variants import get_variant
+
+print(" ".join(get_variant(sys.argv[1]).xla_flags))
+PYEOF
+)
+fi
+
+export XLA_FLAGS="${XLA_FLAGS:-} ${RESOLVED_FLAGS} ${VARIANT_XLA_FLAGS:-}"
 export DLBB_DISTRIBUTED=auto   # dlbb_tpu.cli calls initialize_distributed(auto=True)
+
+if [ "${DLBB_LAUNCH_DRYRUN:-0}" = "1" ]; then
+  echo "XLA_FLAGS=${XLA_FLAGS}"
+  echo "DLBB_DISTRIBUTED=${DLBB_DISTRIBUTED}"
+  echo "exec python -m dlbb_tpu.cli $*"
+  exit 0
+fi
 
 exec python -m dlbb_tpu.cli "$@"

@@ -7,6 +7,7 @@ import json
 import os
 from pathlib import Path
 from typing import Any
+from uuid import uuid4
 
 import yaml
 
@@ -25,11 +26,23 @@ def load_config(path: str | Path) -> dict[str, Any]:
 
 
 def save_json(data: dict[str, Any], path: str | Path) -> Path:
-    """Write a result dict as pretty JSON, creating parent dirs."""
+    """Write a result dict as pretty JSON, creating parent dirs.
+
+    Write-to-tmp + ``os.replace`` so a killed run (time-budgeted publisher
+    sweeps) can never leave a truncated artifact behind — resume-mode sweeps
+    trust file existence, so a partial JSON would be skipped forever and
+    leak into the committed corpus."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(data, f, indent=2, default=_jsonify)
+    # unique tmp name: concurrent writers (multi-host sweeps on a shared
+    # filesystem) must not truncate each other's in-flight tmp file
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.{uuid4().hex[:8]}.tmp")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=2, default=_jsonify)
+        os.replace(tmp, path)
+    finally:
+        tmp.unlink(missing_ok=True)
     return path
 
 

@@ -57,6 +57,13 @@ from dlbb_tpu.bench.runner import (  # noqa: E402
 RESULTS = REPO / "results"
 STATS = REPO / "stats"
 
+# Sweeps resume by default: the publisher is time-budgeted and routinely
+# interrupted, and one-JSON-per-config makes resumption natural (the
+# reference resumes the same way, SURVEY §5.4).  ``--fresh`` re-measures
+# everything — REQUIRED after changing measurement/timing code, otherwise a
+# rerun would silently rebuild stats from the stale committed corpus.
+RESUME = True
+
 GIB = 2**30
 
 # Executable variant matrix (the fusion/threshold XLA_FLAGS variants need a
@@ -106,6 +113,7 @@ def stage_1d() -> None:
         output_dir=str(out),
         max_config_seconds=20.0,
         max_global_bytes=24 * GIB,
+        resume=RESUME,
     ))
     # extended sizes: fewer rank counts, tighter budget — the big-payload
     # tail of the north-star 1KB..1GB curve
@@ -115,6 +123,7 @@ def stage_1d() -> None:
         output_dir=str(out),
         max_config_seconds=15.0,
         max_global_bytes=24 * GIB,
+        resume=RESUME,
     ))
 
 
@@ -124,6 +133,7 @@ def stage_3d() -> None:
         output_dir=str(RESULTS / "3d" / "xla_tpu"),
         max_config_seconds=12.0,
         max_global_bytes=40 * GIB,
+        resume=RESUME,
     ))
 
 
@@ -137,6 +147,7 @@ def stage_variants() -> None:
             output_dir=str(RESULTS / "variants" / _impl(name)),
             max_config_seconds=20.0,
             max_global_bytes=24 * GIB,
+            resume=RESUME,
         ))
 
 
@@ -322,7 +333,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--stage", default="all",
                     choices=["all", *STAGES])
+    ap.add_argument("--fresh", action="store_true",
+                    help="re-measure every config even if its artifact "
+                         "exists (use after changing measurement code)")
     args = ap.parse_args()
+    if args.fresh:
+        global RESUME
+        RESUME = False
     t0 = time.time()
     names = list(STAGES) if args.stage == "all" else [args.stage]
     for name in names:

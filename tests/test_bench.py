@@ -46,6 +46,26 @@ def test_sweep_1d_writes_reference_schema(tmp_path, devices):
     assert (timings > 0).all()
 
 
+def test_sweep_1d_resume_skips_existing(tmp_path, devices):
+    """resume=True picks an interrupted sweep back up: configs whose artifact
+    already exists are not re-measured (their files are untouched), missing
+    ones still run, and the returned list covers the full grid either way."""
+    sweep = _tiny_1d(tmp_path)
+    first = run_sweep(sweep, verbose=False)
+    assert len(first) == 12
+    # delete two artifacts to simulate an interruption mid-grid
+    removed = {first[3], first[7]}
+    for p in removed:
+        p.unlink()
+    mtimes = {p: p.stat().st_mtime_ns for p in first if p not in removed}
+    resumed = run_sweep(_tiny_1d(tmp_path, resume=True), verbose=False)
+    assert sorted(resumed) == sorted(first)
+    for p, t in mtimes.items():
+        assert p.stat().st_mtime_ns == t, f"{p.name} was re-measured"
+    for p in removed:
+        assert p.exists(), f"{p.name} was not re-run"
+
+
 def test_sweep_1d_rank_gate(tmp_path, devices):
     files = run_sweep(_tiny_1d(tmp_path, rank_counts=(16,)), verbose=False)
     assert files == []  # all configs infeasible on 8 devices

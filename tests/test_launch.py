@@ -1,0 +1,84 @@
+"""Launcher-layer contract tests.
+
+The reference's launch layer is mpirun/deepspeed shell scripts exporting the
+CCL_* tuning env before spawning ranks (``collectives/3d/launch_dsccl.sh:34-74``).
+The TPU analogue carries process-start ``XLA_FLAGS`` (collective-combiner
+thresholds — the ``CCL_FUSION_BYTES_THRESHOLD`` analogue) which cannot be
+applied after backend init, so the only place they can be honoured is the
+launcher.  These tests pin that contract without a pod via the launcher's
+dry-run mode, and pin the runner-side gate that refuses to run a flag
+variant whose flags are absent (mislabelled results are worse than errors).
+"""
+
+import os
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from dlbb_tpu.bench.runner import Sweep1D, _check_variant_flags, run_sweep
+from dlbb_tpu.comm.variants import VARIANTS, get_variant
+
+LAUNCHER = Path(__file__).resolve().parents[1] / "dlbb_tpu" / "launch" / "launch_tpu_pod.sh"
+
+
+def _dryrun(*args: str, env_extra: dict | None = None) -> str:
+    env = dict(os.environ)
+    env["DLBB_LAUNCH_DRYRUN"] = "1"
+    env.update(env_extra or {})
+    out = subprocess.run(
+        ["bash", str(LAUNCHER), *args],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_launcher_injects_combiner_threshold_flags():
+    stdout = _dryrun("bench1d", "--variant", "combine4mb", "--ranks", "8")
+    assert "--xla_tpu_all_reduce_combine_threshold_bytes=4194304" in stdout
+    assert "exec python -m dlbb_tpu.cli bench1d --variant combine4mb" in stdout
+
+
+def test_launcher_injects_flags_for_equals_form():
+    stdout = _dryrun("bench1d", "--variant=combine128mb")
+    assert "--xla_tpu_all_reduce_combine_threshold_bytes=134217728" in stdout
+
+
+def test_launcher_plain_variant_adds_no_flags():
+    stdout = _dryrun("bench1d", "--variant", "ring")
+    xla_line = next(l for l in stdout.splitlines() if l.startswith("XLA_FLAGS="))
+    assert "combine_threshold" not in xla_line
+
+
+def test_launcher_manual_override_still_respected():
+    stdout = _dryrun(
+        "bench1d",
+        env_extra={"VARIANT_XLA_FLAGS": "--xla_tpu_all_reduce_combine_threshold_bytes=1048576"},
+    )
+    assert "--xla_tpu_all_reduce_combine_threshold_bytes=1048576" in stdout
+
+
+def test_every_flag_variant_is_launcher_resolvable():
+    """Each flag-carrying variant resolves through the same path the
+    launcher uses — no variant can silently carry unlaunchable metadata."""
+    for name, v in VARIANTS.items():
+        if v.xla_flags:
+            stdout = _dryrun("bench1d", "--variant", name)
+            for flag in v.xla_flags:
+                assert flag in stdout, (name, flag)
+
+
+def test_runner_refuses_flag_variant_without_flags(monkeypatch):
+    monkeypatch.delenv("XLA_FLAGS", raising=False)
+    with pytest.raises(RuntimeError, match="combine4mb"):
+        _check_variant_flags(get_variant("combine4mb"))
+    # run_sweep goes through the same gate before touching any device
+    with pytest.raises(RuntimeError, match="requires XLA_FLAGS"):
+        run_sweep(Sweep1D(variant="combine4mb"), verbose=False)
+
+
+def test_runner_accepts_flag_variant_with_flags_present(monkeypatch):
+    flags = " ".join(get_variant("combine4mb").xla_flags)
+    monkeypatch.setenv("XLA_FLAGS", flags)
+    _check_variant_flags(get_variant("combine4mb"))  # no raise
