@@ -424,6 +424,10 @@ def run_train(
         num_microbatches=num_microbatches, moe_aux_weight=moe_aux_weight,
         grad_accum=grad_accum, pipeline_schedule=pipeline_schedule,
     )
+    # make_train_step may have resharded params into fresh buffers (ZeRO-3);
+    # at 13B scale the caller's copy is tens of GB of dead weight on the
+    # host simulating the mesh — drop the reference before the step runs
+    del params
 
     # Checkpoint / resume (no reference analogue — SURVEY §5.4 "none"; see
     # dlbb_tpu/train/checkpoint.py).  Resume happens before warmup so the

@@ -151,11 +151,15 @@ def time_fn_per_iter(
         jax.block_until_ready(fn(*args))
         probe = time.perf_counter() - t0
         warmup_run += 1
-        affordable = max(3, int(max_seconds / max(probe, 1e-9)))
+        # when even the 3-sample floor cannot fit the budget (huge payloads
+        # on the single-core simulated host), drop the floor to 1 — one
+        # honest recorded sample beats minutes of over-budget re-runs
+        floor = 1 if 3 * probe > max_seconds else 3
+        affordable = max(floor, int(max_seconds / max(probe, 1e-9)))
         if affordable < warmup + iterations:
             clamped = True
             warmup = min(warmup, max(0, affordable // 10))
-            iterations = min(iterations, max(3, affordable - warmup))
+            iterations = min(iterations, max(floor, affordable - warmup))
     for _ in range(max(0, warmup - warmup_run)):
         jax.block_until_ready(fn(*args))
         warmup_run += 1

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -44,7 +45,13 @@ sys.path.insert(0, str(REPO))
 
 from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
 
-force_cpu_simulation(8)
+# The simulated device count is a process-start property (XLA_FLAGS).  The
+# default 8-device mesh covers the reference's {2,4,8} rank sweeps; the
+# reference's HEADLINE rows are at 16 ranks (BASELINE.md: oneCCL allreduce
+# "16MB" @ 16 ranks), so the ``1d16``/``3d16`` stages run in a SECOND
+# invocation with DLBB_PUBLISH_DEVICES=16.
+N_DEVICES = int(os.environ.get("DLBB_PUBLISH_DEVICES", "8"))
+force_cpu_simulation(N_DEVICES)
 
 from dlbb_tpu.bench.runner import (  # noqa: E402
     DATA_SIZES_1D,
@@ -122,7 +129,11 @@ def stage_1d() -> None:
         rank_counts=(4, 8),
         output_dir=str(out),
         max_config_seconds=15.0,
-        max_global_bytes=24 * GIB,
+        # quadratic-footprint ops (allgather/gather/alltoall) at the big
+        # labels would otherwise spend tens of minutes shuffling host RAM
+        # on the single simulating core — informative about nothing; the
+        # skip is logged and the absence is the honest artifact
+        max_global_bytes=8 * GIB,
         resume=RESUME,
     ))
 
@@ -131,8 +142,60 @@ def stage_3d() -> None:
     log("3D reference grid")
     run_sweep(Sweep3D(
         output_dir=str(RESULTS / "3d" / "xla_tpu"),
-        max_config_seconds=12.0,
-        max_global_bytes=40 * GIB,
+        max_config_seconds=8.0,
+        # 4 GiB global-footprint cap: above it a single iteration on the
+        # one simulating core takes minutes (rendezvous threads thrashing
+        # host RAM) and the full reference grid would not finish in a day.
+        # Skips are logged per config; the honest artifact for those rows
+        # is their absence + the skip line, not a number measuring nothing
+        # but swap behaviour.
+        max_global_bytes=4 * GIB,
+        resume=RESUME,
+    ))
+
+
+def _require_devices(n: int, stage: str) -> bool:
+    if N_DEVICES < n:
+        log(f"SKIP stage {stage}: needs DLBB_PUBLISH_DEVICES={n} "
+            f"(have {N_DEVICES}) — rerun as "
+            f"DLBB_PUBLISH_DEVICES={n} python scripts/publish_baselines.py "
+            f"--stage {stage}")
+        return False
+    return True
+
+
+def stage_1d16() -> None:
+    """16-rank canonical 1D grid — the reference's HEADLINE rank count
+    (BASELINE.md: every 1D headline row, e.g. oneCCL allreduce "16MB"
+    4.94 ms / 23.29 GB/s, is at 16 ranks;
+    ``collectives/1d/stats/dsccl/benchmark_statistics.csv:18``).  Runs in a
+    separate 16-device invocation (DLBB_PUBLISH_DEVICES=16)."""
+    if not _require_devices(16, "1d16"):
+        return
+    log("1D canonical grid @ 16 ranks (reference headline rank count)")
+    run_sweep(Sweep1D(
+        rank_counts=(16,),
+        output_dir=str(RESULTS / "1d" / "xla_tpu"),
+        max_config_seconds=15.0,
+        max_global_bytes=24 * GIB,
+        resume=RESUME,
+    ))
+
+
+def stage_3d16() -> None:
+    """16-rank 3D allreduce grid — the reference sweeps 3D at ranks
+    {4,8,16} (``collectives/3d/openmpi.py:19``); its 16-rank tuning corpus
+    is allreduce-focused (SURVEY §2.3), so allreduce is what runs here under
+    the single-core time budget."""
+    if not _require_devices(16, "3d16"):
+        return
+    log("3D allreduce grid @ 16 ranks")
+    run_sweep(Sweep3D(
+        operations=("allreduce",),
+        rank_counts=(16,),
+        output_dir=str(RESULTS / "3d" / "xla_tpu"),
+        max_config_seconds=8.0,
+        max_global_bytes=4 * GIB,
         resume=RESUME,
     ))
 
@@ -182,25 +245,39 @@ def stage_train() -> None:
 
 def stage_13b() -> None:
     """Full-depth 13B (hidden 5120 x 40 layers, reference
-    ``models.py:265-270``) ONE real train step, ZeRO-3/FSDP + remat +
-    adafactor, bf16, tiny sequence, on the simulated 8-device mesh — the
-    committed evidence that the largest reference model size actually
-    trains under this framework's sharding (see ``docs/13b_single_chip.md``
-    for why this cannot run on the single 16 GB chip).  Adafactor keeps
-    optimizer state sublinear so the single host simulating all 8 devices
-    holds params (23.4 GiB bf16) + transient grads within RAM."""
-    from dlbb_tpu.train.loop import run_train
+    ``models.py:265-270``): the committed evidence that the largest
+    reference model size actually runs under this framework's sharding.
 
-    log("13B full-depth train step (zero3 + remat + adafactor, dp=8)")
+    Two artifacts, scoped to what the hardware can honestly measure:
+
+    - **Forward benchmark, full depth, Megatron TP=8** (``results/e2e``) —
+      exact reference parity: ``run_mpi.py`` is a forward-pass benchmark
+      and the reference NEVER trains 13B (its only backward pass is the
+      2-layer toy in ``test/ccl.py``).  TP-sharded weights are consumed in
+      place by the sharded matmuls, so the host simulating all 8 devices
+      holds the 23.4 GiB parameters exactly once.
+    - **Training at true 13B layer geometry** — driver dryrun phase 9
+      (``__graft_entry__.py``): ZeRO-3 + remat at h=5120/40-head/ffn-20480
+      with depth 2; layers are scanned, so the compiled per-layer program
+      and shardings equal the 40-layer model's.
+
+    A full-depth 13B *training* step exceeds this host: XLA CPU
+    materialises fp32 copies of bf16 weight stacks for the backward
+    matmuls (~6x parameter bytes peak, measured 130+ GiB OOM at 125 GiB;
+    with swap the in-process collective rendezvous stuck-detector aborts
+    instead).  See ``docs/13b_single_chip.md`` for the single-chip HBM
+    arithmetic and the real-pod story."""
+    from dlbb_tpu.bench.e2e import run_e2e
+
+    log("13B full-depth forward benchmark (tp=8)")
     config = {
-        "experiment": {"name": "13B_zero3_remat_dp8"},
-        "model": {"size": "13B", "attention": "full", "remat": True},
-        "parallelism": {"world_size": 1, "data_parallel": 8},
-        "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
-        "execution": {"warmup_iterations": 1, "benchmark_iterations": 2},
-        "training": {"learning_rate": 1e-4, "optimizer": "adafactor"},
+        "experiment": {"name": "13B_tp8_forward"},
+        "model": {"size": "13B", "attention": "full"},
+        "parallelism": {"world_size": 8},  # world_size IS the TP degree
+        "input": {"batch_size": 2, "sequence_length": 64, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 3},
     }
-    run_train(config, zero_stage=3, output_dir=str(RESULTS / "train"))
+    run_e2e(config, output_dir=str(RESULTS / "e2e"))
 
 
 def stage_multichip() -> None:
@@ -268,8 +345,10 @@ def stage_baseline() -> None:
     baseline_path = REPO / "BASELINE.json"
     data = json.loads(baseline_path.read_text())
     published: dict = {
-        "host": "single-core CPU, 8 simulated XLA devices "
-                "(xla_force_host_platform_device_count)",
+        "host": "single-core CPU, simulated XLA device mesh "
+                "(xla_force_host_platform_device_count; 8 devices for the "
+                "2/4/8-rank stages, 16 for the ranks-16 stages — each "
+                "artifact records its own mesh_shape + system_info)",
         "note": "collective numbers are host-RAM bandwidth, not ICI; the "
                 "TPU-chip numbers live in results/e2e + BENCH_r*.json",
         "artifacts": {
@@ -319,6 +398,8 @@ def stage_baseline() -> None:
 STAGES = {
     "1d": stage_1d,
     "3d": stage_3d,
+    "1d16": stage_1d16,
+    "3d16": stage_3d16,
     "variants": stage_variants,
     "train": stage_train,
     "13b": stage_13b,
