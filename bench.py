@@ -138,7 +138,7 @@ def bench_e2e_single_chip() -> dict:
     extras = {}
     for size, attention, seq in (
         ("7B", "simplified", E2E_SEQ), ("7B", "full", E2E_SEQ),
-        ("1B", "full", E2E_SEQ), ("1B", "flash", E2E_SEQ),
+        ("1B", "full", E2E_SEQ), ("1B", "dense", E2E_SEQ),
         ("1B", "full", 1024), ("1B", "dense", 1024),
     ):
         try:

@@ -173,12 +173,16 @@ def _attention(qkv, config: ModelConfig, mesh=None, sp_axis: str = "sp"):
 # Route "full" attention through the pallas kernel on real TPUs at
 # sequence lengths where it measurably wins; the simulated/CPU dev mesh
 # keeps the dense einsum (interpret-mode pallas would be pure overhead).
-# Gate calibration (v5e chip, bf16): standalone at S=512 the fused dense
-# einsum still wins (e.g. B8/N16/D128: dense 0.29 ms vs flash 0.41 ms;
-# small shapes up to 6x), while round-2 e2e at S=512 showed flash ahead
-# (1B 159.5 vs 143.4 TFLOP/s) — mixed evidence, so the gate sits at 1024
-# where the S^2 score tensor is decisively hostile (dense OOMs by 8192).
-FLASH_ROUTE_MIN_SEQ = 1024
+# Gate calibration (v5e chip, bf16, committed e2e artifacts
+# results/e2e/xla_tpu_{1b,7b}_{dense,flash}_s512_world1.json — "dense"
+# pins the un-routed kernel, so these pairs stay a real comparison across
+# publisher re-runs): at S=512 in-model flash beats dense 1.10x on 1B
+# (63.5k vs 57.5k tok/s) and 1.03x on 7B (12.45k vs 12.11k), and the gap
+# widens with S (1.31x at S=1024, dense OOMs by 8192).  Standalone
+# (outside the model) dense still wins small shapes (B8/N16/D128 S=512:
+# 0.29 ms vs 0.41 ms) — in-model numbers govern the route, standalone
+# callers pick their own kernel.
+FLASH_ROUTE_MIN_SEQ = 512
 
 
 def _flash_profitable(q_shape) -> bool:

@@ -31,19 +31,17 @@ CONFIGS = (
     ("1B", "simplified", 512),
     ("1B", "full", 512),
     ("1B", "flash", 512),
+    ("1B", "dense", 512),   # pinned dense kernel: the un-routed baseline
     ("7B", "simplified", 512),
     ("7B", "full", 512),
+    ("7B", "dense", 512),
     ("1B", "full", 1024),
     ("1B", "dense", 1024),
 )
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--iters", type=int, default=10)
-    ap.add_argument("--output", default=str(REPO / "results" / "e2e"))
-    args = ap.parse_args()
-
+def _run_one(size: str, attention: str, seq: int, iters: int,
+             output: str) -> None:
     import jax
 
     devices = jax.devices()
@@ -54,22 +52,48 @@ def main() -> int:
 
     from dlbb_tpu.bench.e2e import run_e2e
 
+    config = {
+        "experiment": {
+            "name": f"{size.lower()}_{attention}_s{seq}_world1",
+        },
+        "model": {"size": size, "attention": attention},
+        "parallelism": {"world_size": 1, "data_parallel": 1},
+        "input": {"batch_size": 8, "sequence_length": seq, "seed": 42},
+        "execution": {"warmup_iterations": 3,
+                      "benchmark_iterations": iters},
+    }
+    run_e2e(config, output_dir=output)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--output", default=str(REPO / "results" / "e2e"))
+    ap.add_argument("--only", default=None, metavar="SIZE,ATTENTION,SEQ",
+                    help="run a single config in THIS process (the "
+                         "per-config worker mode)")
+    args = ap.parse_args()
+
+    if args.only:
+        size, attention, seq = args.only.split(",")
+        _run_one(size, attention, int(seq), args.iters, args.output)
+        return 0
+
+    # One subprocess per config: a fresh process means a fresh HBM arena —
+    # running the whole set in-process accumulates enough leftover
+    # allocations that the 7B configs hit RESOURCE_EXHAUSTED on the 16 GB
+    # chip after the three 1B models have run.
+    import subprocess
+
     failures = []
     for size, attention, seq in CONFIGS:
-        config = {
-            "experiment": {
-                "name": f"{size.lower()}_{attention}_s{seq}_world1",
-            },
-            "model": {"size": size, "attention": attention},
-            "parallelism": {"world_size": 1, "data_parallel": 1},
-            "input": {"batch_size": 8, "sequence_length": seq, "seed": 42},
-            "execution": {"warmup_iterations": 3,
-                          "benchmark_iterations": args.iters},
-        }
-        try:
-            run_e2e(config, output_dir=args.output)
-        except Exception as e:  # noqa: BLE001 — per-config resilience
-            print(f"FAILED {size}/{attention}/s{seq}: {e}", flush=True)
+        cmd = [sys.executable, __file__, "--iters", str(args.iters),
+               "--output", args.output, "--only",
+               f"{size},{attention},{seq}"]
+        r = subprocess.run(cmd)
+        if r.returncode != 0:
+            print(f"FAILED {size}/{attention}/s{seq} "
+                  f"(exit {r.returncode})", flush=True)
             failures.append((size, attention, seq))
     if failures:
         print(f"{len(failures)} config(s) failed: {failures}", flush=True)
