@@ -7,6 +7,7 @@ elegance (SURVEY §7 step 3) — this is the judged artifact format.
 """
 
 from dlbb_tpu.stats.compare import write_comparison
+from dlbb_tpu.stats.variants_report import write_variants_report
 from dlbb_tpu.stats.stats1d import (
     calculate_bandwidth,
     calculate_statistics,
@@ -20,4 +21,5 @@ __all__ = [
     "process_1d_results",
     "process_3d_results",
     "write_comparison",
+    "write_variants_report",
 ]

@@ -1,0 +1,137 @@
+"""Variant tuning comparison — which mesh/reduction variant wins.
+
+The reference's tuning story is encoded in its result directories: 8
+``CCL_ALLREDUCE`` algorithms x worker counts x fusion toggles, each a
+``dsccl_*`` corpus dir whose stats answer "which algorithm is fastest at
+which size" (SURVEY §2.3; e.g. ``collectives/3d/stats/dscclworker4/``).
+This module is the dlbb_tpu capstone of that axis: it joins the committed
+``stats/variants/<impl>/benchmark_statistics.csv`` files (produced by the
+publisher's variants stage over the executable variant matrix) into one
+per-size comparison table with the winning variant per row, emitted as a
+committed CSV + markdown report.
+
+Comparison is at the largest rank count every variant could execute
+(fixed-shape variants like ``grid2x2x2`` only run at their mesh size — 8);
+the join drops variants missing a row rather than guessing.
+"""
+
+from __future__ import annotations
+
+import csv
+from pathlib import Path
+from typing import Any, Optional
+
+
+def _read_rows(csv_path: Path) -> list[dict[str, Any]]:
+    with csv_path.open() as f:
+        return list(csv.DictReader(f))
+
+
+def collect_variant_rows(
+    variants_stats_root: Path,
+    operation: str = "allreduce",
+    num_ranks: int = 8,
+) -> tuple[dict[str, dict[str, float]], dict[str, int]]:
+    """``({impl: {data_size_name: mean_time_us}}, {data_size_name:
+    num_elements})`` for one (op, ranks).  Empty dicts when the stats root
+    does not exist yet (fresh tree)."""
+    out: dict[str, dict[str, float]] = {}
+    size_elems: dict[str, int] = {}
+    root = Path(variants_stats_root)
+    if not root.is_dir():
+        return out, size_elems
+    for impl_dir in sorted(root.iterdir()):
+        stats_csv = impl_dir / "benchmark_statistics.csv"
+        if not impl_dir.is_dir() or not stats_csv.exists():
+            continue
+        rows: dict[str, float] = {}
+        for r in _read_rows(stats_csv):
+            if (r["operation"] != operation
+                    or int(r["num_ranks"]) != num_ranks):
+                continue
+            size = r["data_size_name"]
+            rows[size] = float(r["mean_time_us"])
+            if r.get("num_elements"):
+                size_elems[size] = int(r["num_elements"])
+        if rows:
+            out[impl_dir.name] = rows
+    return out, size_elems
+
+
+def write_variants_report(
+    variants_stats_root: Path,
+    out_dir: Optional[Path] = None,
+    operation: str = "allreduce",
+    num_ranks: int = 8,
+    baseline_impl: str = "xla_tpu",
+) -> dict[str, Any]:
+    """Emit ``variants_comparison.csv`` + ``VARIANTS.md``; returns the
+    summary (per-size winner and speedup over the default variant)."""
+    out_dir = Path(out_dir) if out_dir is not None else Path(variants_stats_root)
+    data, size_elems = collect_variant_rows(
+        variants_stats_root, operation, num_ranks
+    )
+    if not data:
+        return {"sizes": [], "winners": {}}
+    impls = sorted(data)
+    all_sizes = {s for rows in data.values() for s in rows}
+    # payload size is the true row order; num_elements comes from the same
+    # stats CSVs (mean time would mis-order latency-bound small sizes)
+    sizes = sorted(all_sizes, key=lambda s: size_elems.get(s, 0))
+
+    table: list[dict[str, Any]] = []
+    winners: dict[str, dict[str, Any]] = {}
+    for size in sizes:
+        row: dict[str, Any] = {"data_size_name": size}
+        present = {
+            impl: rows[size] for impl, rows in data.items() if size in rows
+        }
+        for impl in impls:
+            row[impl] = round(present[impl], 3) if impl in present else None
+        winner = min(present, key=present.get)  # type: ignore[arg-type]
+        row["winner"] = winner
+        base = present.get(baseline_impl)
+        speedup = (
+            round(base / present[winner], 4)
+            if base is not None and present[winner] > 0 else None
+        )
+        row["winner_speedup_vs_default"] = speedup
+        winners[size] = {
+            "winner": winner,
+            "mean_time_us": round(present[winner], 3),
+            "speedup_vs_default": speedup,
+        }
+        table.append(row)
+
+    out_dir.mkdir(parents=True, exist_ok=True)
+    columns = ["data_size_name", *impls, "winner", "winner_speedup_vs_default"]
+    with (out_dir / "variants_comparison.csv").open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=columns)
+        w.writeheader()
+        w.writerows(table)
+
+    md = [
+        f"# Variant tuning comparison — {operation} @ {num_ranks} ranks",
+        "",
+        "Per-size mean time (µs) across the executable tuning variants "
+        "(`dlbb_tpu/comm/variants.py`) — the analogue of the reference's "
+        "`CCL_ALLREDUCE` algorithm sweep corpus (SURVEY §2.3).  "
+        f"`winner_speedup_vs_default` is {baseline_impl} mean / winner "
+        "mean (>1: tuning beats the default).  Blank cells: that variant "
+        "has no row at this size (fixed-shape meshes only run at their "
+        "own rank count; memory-capped configs are skipped).",
+        "",
+        "| " + " | ".join(columns) + " |",
+        "|" + "---|" * len(columns),
+    ]
+    for row in table:
+        md.append(
+            "| "
+            + " | ".join(
+                "" if row.get(c) is None else str(row[c]) for c in columns
+            )
+            + " |"
+        )
+    md.append("")
+    (out_dir / "VARIANTS.md").write_text("\n".join(md))
+    return {"sizes": sizes, "winners": winners}

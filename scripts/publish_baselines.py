@@ -316,6 +316,13 @@ def stage_stats() -> None:
         if in_dir.exists():
             process_1d_results(in_dir, STATS / "variants" / impl,
                                verbose=False)
+    from dlbb_tpu.stats import write_variants_report
+
+    summary = write_variants_report(STATS / "variants")
+    for size, w in summary["winners"].items():
+        vs = (f"{w['speedup_vs_default']}x vs default"
+              if w["speedup_vs_default"] is not None else "no default row")
+        log(f"  variants {size}: {w['winner']} ({vs})")
 
 
 def stage_compare() -> None:
@@ -375,6 +382,21 @@ def stage_baseline() -> None:
              ("num_ranks", "mean_time_us", "bandwidth_gbps")}
             for r in pick
         ]
+    e2e_dir = RESULTS / "e2e"
+    if e2e_dir.exists():
+        e2e = {}
+        for pth in sorted(e2e_dir.glob("*.json")):
+            r = json.loads(pth.read_text())
+            e2e[r["experiment"]["name"]] = {
+                "tokens_per_second": round(r["tokens_per_second"], 1),
+                "achieved_tflops_per_second": round(
+                    r["achieved_tflops_per_second"], 2),
+                "backend": r.get("backend"),
+            }
+        published["e2e_corpus"] = e2e
+    vr = STATS / "variants" / "variants_comparison.csv"
+    if vr.exists():
+        published["variants_report"] = str(vr.relative_to(REPO))
     mc = RESULTS / "multichip" / "bench_allreduce_multichip_8ranks.json"
     if mc.exists():
         published["multichip_headline"] = json.loads(mc.read_text())

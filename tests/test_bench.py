@@ -361,6 +361,51 @@ def test_bench_allreduce_multichip_schema(devices):
     )
 
 
+def test_variants_report_picks_winner(tmp_path):
+    """The tuning-comparison capstone: per-size join over variant stats
+    CSVs, winner + speedup-vs-default computed, fixed-shape variants with
+    missing rank rows dropped rather than guessed."""
+    import csv
+
+    from dlbb_tpu.stats import write_variants_report
+
+    cols = ["mpi_implementation", "operation", "num_ranks",
+            "data_size_name", "mean_time_us"]
+
+    def fake(impl, rows):
+        d = tmp_path / impl
+        d.mkdir()
+        with (d / "benchmark_statistics.csv").open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=cols)
+            w.writeheader()
+            for size, mean in rows:
+                w.writerow({"mpi_implementation": impl,
+                            "operation": "allreduce", "num_ranks": 8,
+                            "data_size_name": size, "mean_time_us": mean})
+
+    fake("xla_tpu", [("1KB", 100.0), ("16MB", 9000.0)])
+    fake("xla_tpu_hier2x4", [("1KB", 50.0), ("16MB", 12000.0)])
+    fake("xla_tpu_grid2x2x2", [("1KB", 200.0)])  # no 16MB row
+
+    summary = write_variants_report(tmp_path)
+    assert summary["winners"]["1KB"]["winner"] == "xla_tpu_hier2x4"
+    assert summary["winners"]["1KB"]["speedup_vs_default"] == 2.0
+    assert summary["winners"]["16MB"]["winner"] == "xla_tpu"
+    assert (tmp_path / "VARIANTS.md").exists()
+    with (tmp_path / "variants_comparison.csv").open() as f:
+        rows = {r["data_size_name"]: r for r in csv.DictReader(f)}
+    assert rows["16MB"]["xla_tpu_grid2x2x2"] == ""  # absent, not guessed
+    # markdown renders absent cells blank, never the string "None"
+    assert "None" not in (tmp_path / "VARIANTS.md").read_text()
+
+
+def test_variants_report_fresh_tree(tmp_path):
+    from dlbb_tpu.stats import write_variants_report
+
+    summary = write_variants_report(tmp_path / "does_not_exist")
+    assert summary == {"sizes": [], "winners": {}}
+
+
 def test_stats_reads_reference_artifact(tmp_path):
     """The pipeline must ingest the reference's own result JSONs (same
     schema, 'mpi_implementation' key)."""
