@@ -58,6 +58,16 @@ def collect_variant_rows(
     return out, size_elems
 
 
+def _parse_size_label(label: str) -> int:
+    """'64KB' -> 65536, '1GB' -> 2**30; unparseable labels sort first."""
+    import re
+
+    m = re.fullmatch(r"(\d+)(KB|MB|GB)", label.strip())
+    if not m:
+        return 0
+    return int(m.group(1)) * {"KB": 2**10, "MB": 2**20, "GB": 2**30}[m.group(2)]
+
+
 def write_variants_report(
     variants_stats_root: Path,
     out_dir: Optional[Path] = None,
@@ -76,8 +86,13 @@ def write_variants_report(
     impls = sorted(data)
     all_sizes = {s for rows in data.values() for s in rows}
     # payload size is the true row order; num_elements comes from the same
-    # stats CSVs (mean time would mis-order latency-bound small sizes)
-    sizes = sorted(all_sizes, key=lambda s: size_elems.get(s, 0))
+    # stats CSVs, with the size label parsed as fallback (reference-schema
+    # CSVs lack the column) and the name as final tiebreaker so the
+    # committed row order never depends on set-iteration order
+    sizes = sorted(
+        all_sizes,
+        key=lambda s: (size_elems.get(s, _parse_size_label(s)), s),
+    )
 
     table: list[dict[str, Any]] = []
     winners: dict[str, dict[str, Any]] = {}

@@ -387,6 +387,15 @@ def stage_baseline() -> None:
         e2e = {}
         for pth in sorted(e2e_dir.glob("*.json")):
             r = json.loads(pth.read_text())
+            if r.get("status") == "infeasible":
+                # capability-boundary artifacts (e.g. dense@8192) carry a
+                # reason instead of numbers; never let a stale boundary
+                # file shadow a fresh measured artifact of the same name
+                e2e.setdefault(
+                    r["experiment"]["name"],
+                    {"status": "infeasible", "reason": r["reason"]},
+                )
+                continue
             e2e[r["experiment"]["name"]] = {
                 "tokens_per_second": round(r["tokens_per_second"], 1),
                 "achieved_tflops_per_second": round(
