@@ -196,10 +196,8 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
     ladder, long-context ladder, infeasibility boundaries)."""
     rows = []
     cpu = repo_root / "bench_baseline_cpu.json"
-    if not cpu.exists():
-        return rows
-    base = json.loads(cpu.read_text())
-    base_tps = base["tokens_per_second"]
+    base_tps = (json.loads(cpu.read_text())["tokens_per_second"]
+                if cpu.exists() else None)
     e2e_dir = repo_root / "results" / "e2e"
     if e2e_dir.exists():
         for f in sorted(e2e_dir.glob("*.json")):
@@ -217,7 +215,7 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
             if r.get("status") == "infeasible":
                 rows.append({
                     "config": f"{name} (results/e2e)",
-                    "device": "v5e chip",
+                    "device": (device if sysinfo else "(not recorded)"),
                     "reference_cpu_stack_tokens_per_s": None,
                     "xla_tpu_tokens_per_s": None,
                     "speedup": None,
@@ -230,7 +228,8 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
             # the CPU-stack baseline was measured at the reference's
             # b8/s512 1B shape — speedup only claimed at that shape,
             # and never for simulated-mesh artifacts
-            comparable = (not simulated and name.startswith("1b_")
+            comparable = (base_tps is not None and not simulated
+                          and name.startswith("1b_")
                           and name.endswith("_s512_world1"))
             rows.append({
                 "config": f"{name} (results/e2e)",
@@ -247,6 +246,8 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
                     else "(no reference number)"
                 ),
             })
+    if base_tps is None:
+        return rows
     for bench_file in sorted(repo_root.glob("BENCH_r*.json")):
         try:
             b = json.loads(bench_file.read_text())
@@ -258,6 +259,7 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
             continue
         rows.append({
             "config": f"1B/simplified ({bench_file.name})",
+            "device": "v5e chip",
             "reference_cpu_stack_tokens_per_s": round(base_tps, 1),
             "xla_tpu_tokens_per_s": b["value"],
             "speedup": round(b["value"] / base_tps, 2),
@@ -266,6 +268,7 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
         for name, extra in b.get("extras", {}).items():
             rows.append({
                 "config": f"{name} ({bench_file.name})",
+                "device": "v5e chip",
                 "reference_cpu_stack_tokens_per_s": None,
                 "xla_tpu_tokens_per_s": extra["tokens_per_second"],
                 "speedup": None,
