@@ -1,0 +1,92 @@
+"""Publisher-script contract tests (corpus provenance guards).
+
+The committed ``results/``+``stats/`` corpus is only as trustworthy as the
+scripts that claim to produce it; these tests pin the failure-handling
+contracts of ``scripts/publish_tpu_e2e.py``'s parent loop without a chip:
+boundary artifacts are written only for expected-infeasible configs whose
+stderr matches a memory/compile signature, other failures still fail the
+run, and success unlinks a stale boundary artifact.
+"""
+
+import importlib.util
+import json
+import sys
+import types
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _load(monkeypatch, tmp_path, run_results):
+    """Import publish_tpu_e2e with subprocess.run faked.
+
+    ``run_results``: {(size, attention, seq): (returncode, stderr)} —
+    configs absent from the dict succeed.
+    """
+    spec = importlib.util.spec_from_file_location(
+        "publish_tpu_e2e", REPO / "scripts" / "publish_tpu_e2e.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    calls = []
+
+    def fake_run(cmd, capture_output=True, text=True):
+        only = cmd[cmd.index("--only") + 1]
+        size, attention, seq = only.split(",")
+        key = (size, attention, int(seq))
+        calls.append(key)
+        rc, stderr = run_results.get(key, (0, ""))
+        return types.SimpleNamespace(
+            returncode=rc, stdout=f"ran {only}\n", stderr=stderr
+        )
+
+    import subprocess
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(
+        sys, "argv", ["publish_tpu_e2e.py", "--output", str(tmp_path)]
+    )
+    return mod, calls
+
+
+def test_boundary_artifact_only_for_memory_signature(monkeypatch, tmp_path):
+    mod, _ = _load(
+        monkeypatch, tmp_path,
+        {("1B", "dense", 8192): (1, "jax: RESOURCE_EXHAUSTED while x\n")},
+    )
+    assert mod.main() == 0
+    art = tmp_path / "xla_tpu_1b_dense_s8192_world1_infeasible.json"
+    data = json.loads(art.read_text())
+    assert data["status"] == "infeasible"
+    assert "RESOURCE_EXHAUSTED" in data["observed_error"]
+    # the deterministic reason comes from the script, not the stderr
+    assert "score tensor" in data["reason"]
+
+
+def test_unexpected_error_at_boundary_config_still_fails(monkeypatch,
+                                                         tmp_path):
+    mod, _ = _load(
+        monkeypatch, tmp_path,
+        {("1B", "dense", 8192): (1, "ImportError: no module named foo\n")},
+    )
+    assert mod.main() == 1  # NOT silently recorded as infeasible
+    assert not list(tmp_path.glob("*_infeasible.json"))
+
+
+def test_failure_outside_expected_set_fails(monkeypatch, tmp_path):
+    mod, _ = _load(
+        monkeypatch, tmp_path,
+        {("7B", "full", 512): (1, "RESOURCE_EXHAUSTED\n")},
+    )
+    assert mod.main() == 1
+    assert not list(tmp_path.glob("*_infeasible.json"))
+
+
+def test_success_unlinks_stale_boundary_artifact(monkeypatch, tmp_path):
+    stale = tmp_path / "xla_tpu_1b_dense_s8192_world1_infeasible.json"
+    stale.write_text("{}")
+    mod, calls = _load(monkeypatch, tmp_path, {})
+    assert mod.main() == 0
+    assert not stale.exists()
+    assert ("1B", "dense", 8192) in calls
