@@ -140,6 +140,7 @@ def bench_e2e_single_chip() -> dict:
         ("7B", "simplified", E2E_SEQ), ("7B", "full", E2E_SEQ),
         ("1B", "full", E2E_SEQ), ("1B", "dense", E2E_SEQ),
         ("1B", "full", 1024), ("1B", "dense", 1024),
+        ("1B", "flash", 8192),   # long-context headline (SURVEY §5.7)
     ):
         try:
             r = _e2e(size, attention, iters=10, seq=seq)

@@ -74,7 +74,7 @@ def write_boundary_artifact(size: str, attention: str, seq: int,
         "status": "infeasible",
         "reason": (
             "dense attention materialises the [B, N, S, S] score tensor "
-            "(16 GiB fp32 at B=8, N=16, S=8192) against the 16 GiB v5e "
+            "(32 GiB fp32 at B=8, N=16, S=8192) against the 16 GiB v5e "
             "HBM; the flash artifact at the same shape is the measured "
             "alternative"
         ),
