@@ -182,6 +182,23 @@ def stage_1d16() -> None:
     ))
 
 
+def stage_1d32() -> None:
+    """32-rank canonical 1D grid — the reference's 1D rank axis extends
+    through 32 and 56 ranks (``collectives/1d/openmpi.py:20``); 32 is the
+    largest power-of-two rung this host can simulate in reasonable time.
+    Runs in a DLBB_PUBLISH_DEVICES=32 invocation."""
+    if not _require_devices(32, "1d32"):
+        return
+    log("1D canonical grid @ 32 ranks")
+    run_sweep(Sweep1D(
+        rank_counts=(32,),
+        output_dir=str(RESULTS / "1d" / "xla_tpu"),
+        max_config_seconds=10.0,
+        max_global_bytes=8 * GIB,
+        resume=RESUME,
+    ))
+
+
 def stage_3d16() -> None:
     """16-rank 3D allreduce grid — the reference sweeps 3D at ranks
     {4,8,16} (``collectives/3d/openmpi.py:19``); its 16-rank tuning corpus
@@ -430,6 +447,7 @@ STAGES = {
     "1d": stage_1d,
     "3d": stage_3d,
     "1d16": stage_1d16,
+    "1d32": stage_1d32,
     "3d16": stage_3d16,
     "variants": stage_variants,
     "train": stage_train,
