@@ -7,7 +7,7 @@ This driver produces the dlbb_tpu analogue and is the provenance record for
 everything under ``results/`` and ``stats/``:
 
 - ``results/1d/xla_tpu/``        canonical reference grid (8 ops x
-  {1KB,64KB,1MB,16MB} x ranks {2,4,8}) plus the extended
+  {1KB,64KB,1MB,16MB} x ranks {2,4,8}; 16/32 via the 1d16/1d32 stages) plus the extended
   {64MB,256MB,1GB} sizes of the north-star curve (BASELINE.json metric)
 - ``results/3d/xla_tpu/``        reference 3D grid (5 ops x B x S x H x
   ranks {4,8}, ``collectives/3d/openmpi.py:19-31``)
@@ -48,8 +48,9 @@ from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
 # The simulated device count is a process-start property (XLA_FLAGS).  The
 # default 8-device mesh covers the reference's {2,4,8} rank sweeps; the
 # reference's HEADLINE rows are at 16 ranks (BASELINE.md: oneCCL allreduce
-# "16MB" @ 16 ranks), so the ``1d16``/``3d16`` stages run in a SECOND
-# invocation with DLBB_PUBLISH_DEVICES=16.
+# "16MB" @ 16 ranks) and its rank axis extends through 32/56, so the
+# ``1d16``/``3d16``/``1d32`` stages run in SEPARATE invocations with
+# DLBB_PUBLISH_DEVICES=16 (or 32).
 N_DEVICES = int(os.environ.get("DLBB_PUBLISH_DEVICES", "8"))
 force_cpu_simulation(N_DEVICES)
 
@@ -371,8 +372,8 @@ def stage_baseline() -> None:
     published: dict = {
         "host": "single-core CPU, simulated XLA device mesh "
                 "(xla_force_host_platform_device_count; 8 devices for the "
-                "2/4/8-rank stages, 16 for the ranks-16 stages — each "
-                "artifact records its own mesh_shape + system_info)",
+                "2/4/8-rank stages, 16/32 for the ranks-16/-32 stages — "
+                "each artifact records its own mesh_shape + system_info)",
         "note": "collective numbers are host-RAM bandwidth, not ICI; the "
                 "TPU-chip numbers live in results/e2e + BENCH_r*.json",
         "artifacts": {
