@@ -200,6 +200,23 @@ def stage_1d32() -> None:
     ))
 
 
+def stage_1d56() -> None:
+    """56-rank canonical 1D grid — the LAST rung of the reference's rank
+    axis (its 56-core node's full width, ``collectives/1d/openmpi.py:20``).
+    With this stage the corpus covers every reference 1D rank count
+    {2,4,8,16,32,56}.  Runs in a DLBB_PUBLISH_DEVICES=56 invocation."""
+    if not _require_devices(56, "1d56"):
+        return
+    log("1D canonical grid @ 56 ranks (full reference rank axis)")
+    run_sweep(Sweep1D(
+        rank_counts=(56,),
+        output_dir=str(RESULTS / "1d" / "xla_tpu"),
+        max_config_seconds=10.0,
+        max_global_bytes=8 * GIB,
+        resume=RESUME,
+    ))
+
+
 def stage_3d16() -> None:
     """16-rank 3D allreduce grid — the reference sweeps 3D at ranks
     {4,8,16} (``collectives/3d/openmpi.py:19``); its 16-rank tuning corpus
@@ -372,7 +389,7 @@ def stage_baseline() -> None:
     published: dict = {
         "host": "single-core CPU, simulated XLA device mesh "
                 "(xla_force_host_platform_device_count; 8 devices for the "
-                "2/4/8-rank stages, 16/32 for the ranks-16/-32 stages — "
+                "2/4/8-rank stages, 16/32/56 for the ranks-16/-32/-56 stages — "
                 "each artifact records its own mesh_shape + system_info)",
         "note": "collective numbers are host-RAM bandwidth, not ICI; the "
                 "TPU-chip numbers live in results/e2e + BENCH_r*.json",
@@ -449,6 +466,7 @@ STAGES = {
     "3d": stage_3d,
     "1d16": stage_1d16,
     "1d32": stage_1d32,
+    "1d56": stage_1d56,
     "3d16": stage_3d16,
     "variants": stage_variants,
     "train": stage_train,
