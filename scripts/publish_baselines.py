@@ -218,15 +218,13 @@ def stage_1d56() -> None:
 
 
 def stage_3d16() -> None:
-    """16-rank 3D allreduce grid — the reference sweeps 3D at ranks
-    {4,8,16} (``collectives/3d/openmpi.py:19``); its 16-rank tuning corpus
-    is allreduce-focused (SURVEY §2.3), so allreduce is what runs here under
-    the single-core time budget."""
+    """16-rank 3D grid, all 5 ops — the reference sweeps 3D at ranks
+    {4,8,16} (``collectives/3d/openmpi.py:19``); with this stage the 3D
+    corpus covers the full reference rank axis."""
     if not _require_devices(16, "3d16"):
         return
-    log("3D allreduce grid @ 16 ranks")
+    log("3D grid @ 16 ranks (all 5 ops)")
     run_sweep(Sweep3D(
-        operations=("allreduce",),
         rank_counts=(16,),
         output_dir=str(RESULTS / "3d" / "xla_tpu"),
         max_config_seconds=8.0,
