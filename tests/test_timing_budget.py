@@ -1,0 +1,52 @@
+"""Budget-floor behavior of per-iteration timing (``time_fn_per_iter``).
+
+Pins the sample-floor contract directly with a synthetic slow function:
+normally at least 3 samples are measured, but when even three iterations
+cannot fit ``max_seconds`` the floor drops to 1 — one honest recorded
+sample instead of a multiple-of-budget overrun.  (The sweep-level budget
+test is in test_bench.py; this one exercises the floor boundary, which a
+real collective cannot hit deterministically.)
+"""
+
+import time
+
+import jax.numpy as jnp
+
+from dlbb_tpu.utils.timing import time_fn_per_iter
+
+
+def _slow_fn(seconds):
+    def fn(x):
+        time.sleep(seconds)
+        return jnp.asarray(x)
+
+    return fn
+
+
+def test_floor_three_samples_when_they_fit():
+    # iteration ~8 ms, budget 80 ms -> clamped but >= 3 samples
+    timings, warmup_run, clamped = time_fn_per_iter(
+        _slow_fn(0.008), 1.0, warmup=10, iterations=100,
+        max_seconds=0.08,
+    )
+    assert clamped
+    assert 3 <= len(timings) < 100
+
+
+def test_floor_drops_to_one_when_three_cannot_fit():
+    # iteration ~60 ms, budget 100 ms: 3 samples would be ~2x budget
+    timings, warmup_run, clamped = time_fn_per_iter(
+        _slow_fn(0.06), 1.0, warmup=10, iterations=100,
+        max_seconds=0.1,
+    )
+    assert clamped
+    assert len(timings) == 1
+    assert timings[0] >= 0.05
+
+
+def test_no_budget_runs_everything():
+    timings, warmup_run, clamped = time_fn_per_iter(
+        _slow_fn(0.0), 1.0, warmup=2, iterations=5, max_seconds=None,
+    )
+    assert not clamped
+    assert len(timings) == 5
