@@ -168,6 +168,10 @@ def process_file(
         # marker: their timing rows are genuine per-iteration samples
         "timing_granularity": data.get("timing_granularity",
                                        "per_iteration"),
+        # measured backend ("cpu" = simulated mesh) — consumed by the
+        # comparison's not_comparable(simulated) verdict; reference
+        # artifacts record no system_info and get None
+        "backend": data.get("system_info", {}).get("backend"),
     }
     if "percentile_caveat" in data:
         out["percentile_caveat"] = data["percentile_caveat"]
@@ -212,7 +216,7 @@ def process_1d_results(
                         k: v
                         for k, v in r.items()
                         if k not in ("per_rank_means_us", "dtype",
-                                     "percentile_caveat")
+                                     "percentile_caveat", "backend")
                     }
                 )
         if verbose:

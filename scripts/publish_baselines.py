@@ -375,7 +375,8 @@ def stage_compare() -> None:
     for dim in ("1d", "3d"):
         s = summary[dim]
         log(f"  {dim}: {s['configs']} configs — {s['beat']} beat, "
-            f"{s['match']} match, {s['lose']} lose")
+            f"{s['match']} match, {s['lose']} lose, "
+            f"{s['not_comparable_simulated']} not_comparable(simulated)")
 
 
 def stage_baseline() -> None:
@@ -429,12 +430,19 @@ def stage_baseline() -> None:
                     {"status": "infeasible", "reason": r["reason"]},
                 )
                 continue
-            e2e[r["experiment"]["name"]] = {
+            # publish the MEASURED backend (system_info), not the label
+            # run_e2e stamps on every artifact — the simulated-mesh rows
+            # (e.g. 13B_tp8_forward) must not read as chip numbers
+            sysinfo = r.get("system_info", {})
+            entry = {
                 "tokens_per_second": round(r["tokens_per_second"], 1),
                 "achieved_tflops_per_second": round(
                     r["achieved_tflops_per_second"], 2),
-                "backend": r.get("backend"),
+                "backend": sysinfo.get("backend", r.get("backend")),
             }
+            if sysinfo.get("backend") == "cpu":
+                entry["simulated"] = True
+            e2e[r["experiment"]["name"]] = entry
         published["e2e_corpus"] = e2e
     vr = STATS / "variants" / "variants_comparison.csv"
     if vr.exists():

@@ -59,6 +59,40 @@ EXPECTED_FAIL_OK = {("1B", "dense", 8192)}
 _BOUNDARY_SIGNATURES = ("RESOURCE_EXHAUSTED", "remote_compile", "Allocat")
 
 
+BATCH_SIZE = 8  # every config in this script runs at B=8 (see _run_one)
+
+
+def _experiment_name(size: str, attention: str, seq: int) -> str:
+    return f"{size.lower()}_{attention}_s{seq}_world1"
+
+
+def _artifact_name(size: str, attention: str, seq: int) -> str:
+    """The ONE producer of the artifact basename — must match what
+    ``run_e2e`` writes (``dlbb_tpu/bench/e2e.py``: ``xla_tpu_<name>.json``
+    from the experiment name this script passes in)."""
+    return f"xla_tpu_{_experiment_name(size, attention, seq)}"
+
+
+def _boundary_reason(size: str, attention: str, seq: int) -> str:
+    """Deterministic boundary reason computed from the config's own
+    parameters (not hardcoded text): the dense path's [B, N, S, S] fp32
+    score tensor vs the 16 GiB v5e HBM."""
+    from dlbb_tpu.models.configs import MODEL_CONFIGS
+
+    # the score-tensor arithmetic below is dense-path physics; a new
+    # EXPECTED_FAIL_OK entry with another attention mode needs its own
+    # reason rather than a factually wrong interpolation of this one
+    assert attention == "dense", attention
+    n_heads = MODEL_CONFIGS[size].num_heads
+    score_gib = BATCH_SIZE * n_heads * seq * seq * 4 / 2**30
+    return (
+        f"{attention} attention materialises the [B, N, S, S] score "
+        f"tensor ({score_gib:.0f} GiB fp32 at B={BATCH_SIZE}, "
+        f"N={n_heads}, S={seq}) against the 16 GiB v5e HBM; the flash "
+        f"artifact at the same shape is the measured alternative"
+    )
+
+
 def write_boundary_artifact(size: str, attention: str, seq: int,
                             output: str, exit_code: int,
                             observed_error: str) -> Path:
@@ -69,22 +103,16 @@ def write_boundary_artifact(size: str, attention: str, seq: int,
     deterministic ``reason`` (why the boundary exists)."""
     boundary = {
         "experiment": {
-            "name": f"{size.lower()}_{attention}_s{seq}_world1",
+            "name": _experiment_name(size, attention, seq),
         },
         "status": "infeasible",
-        "reason": (
-            "dense attention materialises the [B, N, S, S] score tensor "
-            "(32 GiB fp32 at B=8, N=16, S=8192) against the 16 GiB v5e "
-            "HBM; the flash artifact at the same shape is the measured "
-            "alternative"
-        ),
+        "reason": _boundary_reason(size, attention, seq),
         "observed_error": observed_error,
         "exit_code": exit_code,
     }
     out = Path(output)
     out.mkdir(parents=True, exist_ok=True)
-    name = f"xla_tpu_{size.lower()}_{attention}_s{seq}_world1"
-    path = out / f"{name}_infeasible.json"
+    path = out / f"{_artifact_name(size, attention, seq)}_infeasible.json"
     path.write_text(json.dumps(boundary, indent=2) + "\n")
     return path
 
@@ -103,11 +131,12 @@ def _run_one(size: str, attention: str, seq: int, iters: int,
 
     config = {
         "experiment": {
-            "name": f"{size.lower()}_{attention}_s{seq}_world1",
+            "name": _experiment_name(size, attention, seq),
         },
         "model": {"size": size, "attention": attention},
         "parallelism": {"world_size": 1, "data_parallel": 1},
-        "input": {"batch_size": 8, "sequence_length": seq, "seed": 42},
+        "input": {"batch_size": BATCH_SIZE, "sequence_length": seq,
+                  "seed": 42},
         "execution": {"warmup_iterations": 3,
                       "benchmark_iterations": iters},
     }
@@ -144,7 +173,7 @@ def main() -> int:
         if r.returncode == 0:
             # a previously-infeasible config that now measures cleanly
             # must not leave a stale boundary artifact shadowing it
-            name = f"xla_tpu_{size.lower()}_{attention}_s{seq}_world1"
+            name = _artifact_name(size, attention, seq)
             stale = Path(args.output) / f"{name}_infeasible.json"
             stale.unlink(missing_ok=True)
             continue
@@ -155,6 +184,12 @@ def main() -> int:
             and any(sig in r.stderr for sig in _BOUNDARY_SIGNATURES)
         )
         if is_boundary:
+            # a config that regressed to infeasible must not leave its
+            # stale measured artifact shadowing the fresh boundary file
+            # (the mirror of the stale-boundary unlink above)
+            name = _artifact_name(size, attention, seq)
+            stale = Path(args.output) / f"{name}.json"
+            stale.unlink(missing_ok=True)
             write_boundary_artifact(size, attention, seq, args.output,
                                     r.returncode, observed)
             print(f"EXPECTED-INFEASIBLE {size}/{attention}/s{seq} "

@@ -253,14 +253,18 @@ def test_stats_3d_granularity_marker(tmp_path):
     assert "timing_granularity,chunked(5)" in tr
 
 
-def _write_1d_artifact(path, impl, op, ranks, size_name, n, mean_s):
+def _write_1d_artifact(path, impl, op, ranks, size_name, n, mean_s,
+                       backend=None):
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_text(json.dumps({
+    artifact = {
         "mpi_implementation": impl, "operation": op, "num_ranks": ranks,
         "data_size_name": size_name, "num_elements": n, "dtype": "bfloat16",
         "warmup_iterations": 1, "measurement_iterations": 2,
         "timings": [[mean_s, mean_s]] * ranks,
-    }))
+    }
+    if backend is not None:
+        artifact["system_info"] = {"backend": backend}
+    path.write_text(json.dumps(artifact))
 
 
 def test_compare_1d_verdicts(tmp_path):
@@ -288,6 +292,26 @@ def test_compare_1d_verdicts(tmp_path):
     assert r["ref_best_backend"] == "fast"
     assert r["speedup"] == 2.0
     assert r["verdict"] == "beat"
+    assert r["raw_verdict"] == "beat"
+
+
+def test_compare_1d_simulated_rows_are_not_comparable(tmp_path):
+    """Own-side artifacts measured on the simulated mesh (system_info.backend
+    == 'cpu') get the structural not_comparable(simulated) verdict — never
+    'lose' — while the speedup-only raw_verdict is preserved."""
+    from dlbb_tpu.stats.compare import NOT_COMPARABLE, compare_1d
+
+    ref = tmp_path / "ref"
+    _write_1d_artifact(ref / "fast" / "a.json", "fast", "allreduce", 4,
+                       "1KB", 256, 1e-3)
+    own = tmp_path / "own"
+    _write_1d_artifact(own / "a.json", "xla_tpu", "allreduce", 4,
+                       "1KB", 256, 10e-3, backend="cpu")  # 10x slower
+    rows = compare_1d(ref, own)
+    assert len(rows) == 1
+    assert rows[0]["verdict"] == NOT_COMPARABLE
+    assert rows[0]["raw_verdict"] == "lose"
+    assert rows[0]["speedup"] == 0.1
 
 
 def test_compare_report_against_reference_corpus(tmp_path, devices):
@@ -312,7 +336,13 @@ def test_compare_report_against_reference_corpus(tmp_path, devices):
         ref_root, tmp_path / "results", tmp_path / "none3d", out
     )
     assert summary["1d"]["configs"] == 2  # ranks 2 and 4 joined
-    assert sum(summary["1d"][k] for k in ("beat", "match", "lose")) == 2
+    # the sweep ran on the CPU-simulated mesh -> structurally
+    # not_comparable(simulated), never counted as a loss; the speedup-only
+    # raw verdicts are preserved in the sub-breakdown
+    assert summary["1d"]["not_comparable_simulated"] == 2
+    assert sum(summary["1d"][k] for k in ("beat", "match", "lose")) == 0
+    raw = summary["1d"]["not_comparable_raw_verdicts"]
+    assert sum(raw.values()) == 2
     assert (out / "COMPARISON.md").exists()
     assert (out / "comparison_1d.csv").exists()
     md = (out / "COMPARISON.md").read_text()

@@ -90,3 +90,33 @@ def test_success_unlinks_stale_boundary_artifact(monkeypatch, tmp_path):
     assert mod.main() == 0
     assert not stale.exists()
     assert ("1B", "dense", 8192) in calls
+
+
+def test_boundary_unlinks_stale_measured_artifact(monkeypatch, tmp_path):
+    """A config that regressed to infeasible must not leave its stale
+    measured JSON shadowing the fresh boundary artifact (the mirror of the
+    success-path stale-boundary unlink)."""
+    stale = tmp_path / "xla_tpu_1b_dense_s8192_world1.json"
+    stale.write_text("{}")
+    mod, _ = _load(
+        monkeypatch, tmp_path,
+        {("1B", "dense", 8192): (1, "jax: RESOURCE_EXHAUSTED while x\n")},
+    )
+    assert mod.main() == 0
+    assert not stale.exists()
+    assert (tmp_path
+            / "xla_tpu_1b_dense_s8192_world1_infeasible.json").exists()
+
+
+def test_boundary_reason_computed_from_config(monkeypatch, tmp_path):
+    """The deterministic boundary reason reflects the config's own shape
+    parameters (head count from the model table, the actual seq), not a
+    hardcoded dense-1B-8192 string."""
+    mod, _ = _load(monkeypatch, tmp_path, {})
+    reason = mod._boundary_reason("1B", "dense", 8192)
+    # 1B: 16 heads; 8 * 16 * 8192^2 * 4 B = 32 GiB
+    assert "N=16" in reason and "S=8192" in reason and "32 GiB" in reason
+    reason7b = mod._boundary_reason("7B", "dense", 4096)
+    # 7B: 32 heads; 8 * 32 * 4096^2 * 4 B = 16 GiB
+    assert "N=32" in reason7b and "S=4096" in reason7b
+    assert "16 GiB fp32" in reason7b
