@@ -155,9 +155,50 @@ def bench_e2e_single_chip() -> dict:
             }
         except Exception as e:  # noqa: BLE001 — extras never kill the headline
             log(f"extra bench {size}/{attention} failed: {e}")
+    # train-side headline: one real fwd+bwd+optimizer step on the chip with
+    # the reference's optimizer (memory-reduced Adam — bf16 moments, the
+    # config that fits 16 GiB HBM; numerics vs fp32 Adam asserted in
+    # tests/test_optim.py) at the round-4 best remat policy.
+    try:
+        r = _train_step_bench()
+        extras["1B_train_adam_bf16m"] = {
+            "tokens_per_second": round(r["tokens_per_second"], 1),
+            "achieved_tflops_per_second":
+                round(r["achieved_tflops_per_second"], 2),
+            "achieved_tflops_per_second_incl_recompute":
+                round(r["achieved_tflops_per_second_incl_recompute"], 2),
+            "step_mean_ms": round(r["step_time"]["mean"] * 1e3, 3),
+            "remat_policy": r["remat_policy"],
+        }
+    except Exception as e:  # noqa: BLE001 — extras never kill the headline
+        log(f"train bench failed: {e}")
     if extras:
         out["extras"] = extras
     return out
+
+
+def _train_step_bench() -> dict:
+    from dlbb_tpu.train.loop import run_train
+
+    config = {
+        "experiment": {"name": "bench_1b_train_adam_bf16m"},
+        "model": {"size": "1B", "attention": "full", "remat": True,
+                  "remat_policy": "dots"},
+        "parallelism": {"world_size": 1, "data_parallel": 1},
+        "input": {"batch_size": E2E_BATCH, "sequence_length": E2E_SEQ,
+                  "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 5},
+        "training": {"learning_rate": 1e-4, "optimizer": "adam",
+                     "moments_dtype": "bfloat16"},
+    }
+    r = run_train(config, zero_stage=0, verbose=False)
+    log(f"TPU 1B train step (adam/bf16m, remat={r['remat_policy']}): "
+        f"{r['step_time']['mean'] * 1e3:.2f} ms, "
+        f"{r['tokens_per_second']:.0f} tok/s, "
+        f"{r['achieved_tflops_per_second']:.1f} TFLOP/s model "
+        f"({r['achieved_tflops_per_second_incl_recompute']:.1f} incl "
+        "recompute)")
+    return r
 
 
 def main() -> int:

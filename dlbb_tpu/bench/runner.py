@@ -321,12 +321,22 @@ def _resume_exists(path: Path) -> bool:
     return bool(np.asarray(bits).all())
 
 
+# filename tags for non-default dtypes: the bf16 corpus keeps the original
+# (un-suffixed) names so the committed corpus stays stable; other dtypes of
+# the same config coexist in the same directory (north-star curve is
+# "fp32+bf16", BASELINE.json configs[1])
+_DTYPE_FILE_TAG = {"float32": "fp32", "float16": "fp16"}
+
+
 def _result_filename(sweep, impl: str, num_ranks: int, config) -> str:
     op_name = config["operation"]
+    tag = _DTYPE_FILE_TAG.get(sweep.dtype)
+    suffix = f"_{tag}" if tag else ""
     if sweep.kind == "1d":
-        return f"{impl}_{op_name}_ranks{num_ranks}_{config['size_label']}.json"
+        return (f"{impl}_{op_name}_ranks{num_ranks}_"
+                f"{config['size_label']}{suffix}.json")
     b, s, h = config["batch"], config["seq_len"], config["hidden_dim"]
-    return f"{impl}_{op_name}_ranks{num_ranks}_b{b}_s{s}_h{h}.json"
+    return f"{impl}_{op_name}_ranks{num_ranks}_b{b}_s{s}_h{h}{suffix}.json"
 
 
 def _run_one(

@@ -106,6 +106,34 @@ VARIANTS: dict[str, Variant] = {
         mesh_axis_names=("outer", "inner"),
         hierarchical=True,
     ),
+    "grid2x8": Variant(
+        "grid2x8",
+        "2x8 mesh (16 ranks), joint reduction over both axes — the 16-rank "
+        "rung of the mesh-shape tuning axis",
+        mesh_shape=(2, 8),
+        mesh_axis_names=("outer", "inner"),
+    ),
+    "grid4x4": Variant(
+        "grid4x4",
+        "4x4 mesh (16 ranks), joint reduction — square alternative to 2x8",
+        mesh_shape=(4, 4),
+        mesh_axis_names=("outer", "inner"),
+    ),
+    "hier2x8": Variant(
+        "hier2x8",
+        "2x8 mesh, explicit per-axis hierarchical psum: outer(2) then "
+        "inner(8)",
+        mesh_shape=(2, 8),
+        mesh_axis_names=("outer", "inner"),
+        hierarchical=True,
+    ),
+    "hier4x4": Variant(
+        "hier4x4",
+        "4x4 mesh, explicit per-axis hierarchical psum over equal halves",
+        mesh_shape=(4, 4),
+        mesh_axis_names=("outer", "inner"),
+        hierarchical=True,
+    ),
     "grid2x2x2": Variant(
         "grid2x2x2",
         "2x2x2 mesh, joint reduction over all axes (CCL_ALLREDUCE=2d analogue; "

@@ -60,6 +60,16 @@ class ModelConfig:
     # scanned block) — trades ~1/3 more FLOPs for O(layers) less activation
     # HBM, the standard TPU memory/compute trade.
     remat: bool = False
+    # Rematerialisation policy (effective only with remat=True):
+    # - "full": save nothing per block, recompute the whole block forward
+    #   in the backward pass (max memory saving, ~+1 forward of recompute);
+    # - "dots": jax.checkpoint_policies.dots_saveable — save matmul/einsum
+    #   outputs, recompute only the cheap elementwise ops (layernorm, gelu,
+    #   softmax): most of the memory saving at near-zero matmul recompute,
+    #   usually the best MFU point on TPU (the score tensors of dense
+    #   attention are dot outputs, so "dots" keeps them resident — at long
+    #   S prefer "full" or flash attention).
+    remat_policy: str = "full"
 
     def __post_init__(self) -> None:
         if self.hidden_size % self.num_heads != 0:
@@ -87,6 +97,12 @@ class ModelConfig:
             raise ValueError(
                 f"moe_capacity_factor must be > 0, got "
                 f"{self.moe_capacity_factor}"
+            )
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(
+                f"unknown remat_policy {self.remat_policy!r} "
+                "(expected 'full' or 'dots'; remat=False is the no-remat "
+                "point of the ladder)"
             )
         if self.num_kv_heads is not None:
             if not 1 <= self.num_kv_heads <= self.num_heads:
@@ -136,7 +152,7 @@ class ModelConfig:
             "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
             "attention", "dtype", "num_kv_heads", "causal",
             "num_experts", "moe_top_k",
-            "moe_dispatch", "moe_capacity_factor", "remat",
+            "moe_dispatch", "moe_capacity_factor", "remat", "remat_policy",
         ):
             if k in d:
                 fields[k] = d[k]

@@ -405,8 +405,13 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
 
     if config.remat:
         # prevent_cse=False: safe and faster under lax.scan, whose loop
-        # structure already rules out the CSE the default barriers guard
-        body = jax.checkpoint(body, prevent_cse=False)
+        # structure already rules out the CSE the default barriers guard.
+        # Policy selects WHAT each block saves (configs.ModelConfig
+        # remat_policy): "full" saves nothing, "dots" saves matmul outputs
+        # and recomputes only elementwise ops.
+        policy = (jax.checkpoint_policies.dots_saveable
+                  if config.remat_policy == "dots" else None)
+        body = jax.checkpoint(body, prevent_cse=False, policy=policy)
     x, auxs = jax.lax.scan(body, x, params["layers"])
     y = _layernorm(x, params["ln_f"]["scale"], params["ln_f"]["bias"])
     if with_aux:

@@ -48,7 +48,7 @@ BEAT, LOSE = 1.05, 0.95
 NOT_COMPARABLE = "not_comparable(simulated)"
 
 COLUMNS_1D = [
-    "operation", "data_size_name", "num_ranks",
+    "operation", "data_size_name", "num_ranks", "xla_dtype",
     "ref_best_backend", "ref_best_mean_us", "ref_best_bandwidth_gbps",
     "xla_mean_us", "xla_bandwidth_gbps", "speedup", "verdict",
     "raw_verdict",
@@ -135,6 +135,10 @@ def compare_1d(
 
     out = []
     for r in own:
+        # own-side rows are keyed by (op, size, ranks, dtype): the corpus
+        # carries the north-star curve in both bf16 and fp32, each joined
+        # against the same reference best (the reference measured one
+        # dtype — nominal fp16 payloads — per config)
         key = (r["operation"], r["data_size_name"], r["num_ranks"])
         ref = ref_best.get(key)
         if ref is None:
@@ -144,6 +148,7 @@ def compare_1d(
             "operation": key[0],
             "data_size_name": key[1],
             "num_ranks": key[2],
+            "xla_dtype": r.get("dtype", ""),
             "ref_best_backend": ref["backend"],
             "ref_best_mean_us": round(ref["mean_time_us"], 3),
             "ref_best_bandwidth_gbps": (
@@ -159,7 +164,7 @@ def compare_1d(
             **_verdict_pair(speedup, r.get("backend")),
         })
     out.sort(key=lambda r: (r["operation"], r["num_ranks"],
-                            r["xla_mean_us"]))
+                            r["xla_dtype"], r["xla_mean_us"]))
     return out
 
 
@@ -326,6 +331,12 @@ def _counts(rows: list[dict]) -> dict[str, Any]:
     return c
 
 
+def md_table(rows: list[dict], columns: list[str]) -> list[str]:
+    """Markdown table lines (None cells render blank) — the one table
+    emitter shared by every stats report module."""
+    return _md_table(rows, columns)
+
+
 def _md_table(rows: list[dict], columns: list[str]) -> list[str]:
     lines = ["| " + " | ".join(columns) + " |",
              "|" + "---|" * len(columns)]
@@ -351,8 +362,23 @@ def _write_csv(rows: list[dict], columns: list[str], path: Path) -> None:
             w.writerow({k: r.get(k) for k in columns})
 
 
+def _distinct_configs(rows: list[dict]) -> int:
+    """Distinct reference configs covered — dtype is an own-side axis, so
+    a (op, size, ranks) point measured in both bf16 and fp32 is ONE
+    config with two rows."""
+    keys = set()
+    for r in rows:
+        if "data_size_name" in r:
+            keys.add((r["operation"], r["data_size_name"], r["num_ranks"]))
+        else:
+            keys.add((r["operation"], r["num_ranks"], r["batch"],
+                      r["seq_len"], r["hidden_dim"]))
+    return len(keys)
+
+
 def _summary_line(dim: str, rows: list[dict], c: dict) -> str:
-    line = (f"- **{dim}** ({len(rows)} configs): {c['beat']} beat, "
+    line = (f"- **{dim}** ({_distinct_configs(rows)} configs, "
+            f"{len(rows)} rows): {c['beat']} beat, "
             f"{c['match']} match, {c['lose']} lose")
     if c["not_comparable_simulated"]:
         raw = c["not_comparable_raw_verdicts"]
@@ -382,8 +408,10 @@ def write_comparison(
 
     c1, c3 = _counts(rows_1d), _counts(rows_3d)
     summary = {
-        "1d": {"configs": len(rows_1d), **c1},
-        "3d": {"configs": len(rows_3d), **c3},
+        "1d": {"configs": _distinct_configs(rows_1d),
+               "rows": len(rows_1d), **c1},
+        "3d": {"configs": _distinct_configs(rows_3d),
+               "rows": len(rows_3d), **c3},
         "e2e": e2e,
         "thresholds": {"beat": BEAT, "lose": LOSE},
     }

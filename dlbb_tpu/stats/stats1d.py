@@ -61,10 +61,14 @@ CSV_COLUMNS = [
     "p99_time_us",
     "load_imbalance_percent",
     "bandwidth_gbps",
-    # extension column (not in the reference): "per_iteration" or
-    # "chunked(N)" — percentile columns of chunked rows are over chunk
-    # means, not per-iteration tails
+    # extension columns (not in the reference):
+    # - timing_granularity: "per_iteration" or "chunked(N)" — percentile
+    #   columns of chunked rows are over chunk means, not per-iteration tails
+    # - dtype: the measured element type; the corpus carries the north-star
+    #   curve in BOTH bf16 and fp32 (BASELINE.json configs[1]), so rows are
+    #   keyed by (op, size, ranks, dtype)
     "timing_granularity",
+    "dtype",
 ]
 
 
@@ -215,7 +219,7 @@ def process_1d_results(
                     {
                         k: v
                         for k, v in r.items()
-                        if k not in ("per_rank_means_us", "dtype",
+                        if k not in ("per_rank_means_us",
                                      "percentile_caveat", "backend")
                     }
                 )

@@ -68,21 +68,13 @@ def _parse_size_label(label: str) -> int:
     return int(m.group(1)) * {"KB": 2**10, "MB": 2**20, "GB": 2**30}[m.group(2)]
 
 
-def write_variants_report(
-    variants_stats_root: Path,
-    out_dir: Optional[Path] = None,
-    operation: str = "allreduce",
-    num_ranks: int = 8,
-    baseline_impl: str = "xla_tpu",
-) -> dict[str, Any]:
-    """Emit ``variants_comparison.csv`` + ``VARIANTS.md``; returns the
-    summary (per-size winner and speedup over the default variant)."""
-    out_dir = Path(out_dir) if out_dir is not None else Path(variants_stats_root)
-    data, size_elems = collect_variant_rows(
-        variants_stats_root, operation, num_ranks
-    )
-    if not data:
-        return {"sizes": [], "winners": {}}
+def _build_table(
+    data: dict[str, dict[str, float]],
+    size_elems: dict[str, int],
+    baseline_impl: str,
+) -> tuple[list[dict[str, Any]], dict[str, dict[str, Any]], list[str],
+           list[str]]:
+    """(table rows, per-size winners, sizes, impls) for one rank count."""
     impls = sorted(data)
     all_sizes = {s for rows in data.values() for s in rows}
     # payload size is the true row order; num_elements comes from the same
@@ -93,7 +85,6 @@ def write_variants_report(
         all_sizes,
         key=lambda s: (size_elems.get(s, _parse_size_label(s)), s),
     )
-
     table: list[dict[str, Any]] = []
     winners: dict[str, dict[str, Any]] = {}
     for size in sizes:
@@ -117,36 +108,76 @@ def write_variants_report(
             "speedup_vs_default": speedup,
         }
         table.append(row)
+    return table, winners, sizes, impls
+
+
+def write_variants_report(
+    variants_stats_root: Path,
+    out_dir: Optional[Path] = None,
+    operation: str = "allreduce",
+    rank_counts: tuple[int, ...] = (2, 4, 8, 16),
+    primary_ranks: int = 8,
+    baseline_impl: str = "xla_tpu",
+) -> dict[str, Any]:
+    """Emit ``variants_comparison.csv`` (the ``primary_ranks`` table) +
+    per-rank ``variants_comparison_ranks{N}.csv`` + one ``VARIANTS.md``
+    with a section per rank count that has data; returns the summary —
+    the primary table's per-size winners at the top level (legacy shape)
+    plus every rank count's winners under ``"ranks"``."""
+    out_dir = Path(out_dir) if out_dir is not None else Path(variants_stats_root)
+    per_rank: dict[int, tuple] = {}
+    for n in rank_counts:
+        data, size_elems = collect_variant_rows(
+            variants_stats_root, operation, n
+        )
+        if data:
+            per_rank[n] = _build_table(data, size_elems, baseline_impl)
+    if not per_rank:
+        return {"sizes": [], "winners": {}}
+    # the rank count the legacy top-level summary (and the legacy
+    # variants_comparison.csv filename) actually describes: the requested
+    # primary when it has data, else the largest measured rank count —
+    # recorded in the summary so a substitution is never silent
+    primary_n = (primary_ranks if primary_ranks in per_rank
+                 else max(per_rank))
 
     out_dir.mkdir(parents=True, exist_ok=True)
-    columns = ["data_size_name", *impls, "winner", "winner_speedup_vs_default"]
-    with (out_dir / "variants_comparison.csv").open("w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=columns)
-        w.writeheader()
-        w.writerows(table)
-
     md = [
-        f"# Variant tuning comparison — {operation} @ {num_ranks} ranks",
+        f"# Variant tuning comparison — {operation}",
         "",
         "Per-size mean time (µs) across the executable tuning variants "
         "(`dlbb_tpu/comm/variants.py`) — the analogue of the reference's "
-        "`CCL_ALLREDUCE` algorithm sweep corpus (SURVEY §2.3).  "
+        "`CCL_ALLREDUCE` algorithm sweep corpus (SURVEY §2.3), one "
+        "section per measured rank count.  "
         f"`winner_speedup_vs_default` is {baseline_impl} mean / winner "
         "mean (>1: tuning beats the default).  Blank cells: that variant "
         "has no row at this size (fixed-shape meshes only run at their "
         "own rank count; memory-capped configs are skipped).",
         "",
-        "| " + " | ".join(columns) + " |",
-        "|" + "---|" * len(columns),
     ]
-    for row in table:
-        md.append(
-            "| "
-            + " | ".join(
-                "" if row.get(c) is None else str(row[c]) for c in columns
-            )
-            + " |"
-        )
-    md.append("")
+    for n, (table, _, _, impls) in sorted(per_rank.items()):
+        columns = ["data_size_name", *impls, "winner",
+                   "winner_speedup_vs_default"]
+        csv_name = ("variants_comparison.csv" if n == primary_n
+                    else f"variants_comparison_ranks{n}.csv")
+        with (out_dir / csv_name).open("w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=columns)
+            w.writeheader()
+            w.writerows(table)
+        from dlbb_tpu.stats.compare import md_table
+
+        md += [f"## {n} ranks", ""]
+        md += md_table(table, columns)
+        md.append("")
     (out_dir / "VARIANTS.md").write_text("\n".join(md))
-    return {"sizes": sizes, "winners": winners}
+
+    _, winners, sizes, _ = per_rank[primary_n]
+    return {
+        "sizes": sizes,
+        "winners": winners,
+        "primary_rank_count": primary_n,
+        "ranks": {
+            n: {"sizes": s, "winners": w}
+            for n, (_, w, s, _) in sorted(per_rank.items())
+        },
+    }

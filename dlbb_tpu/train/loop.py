@@ -287,7 +287,11 @@ def make_train_step(
                     f"micro-batch size {b // grad_accum} (batch_size={b} / "
                     f"grad_accum={grad_accum}) not divisible by "
                     f"dp={dp_size}; each micro-step reshards the batch "
-                    "instead of keeping the dp layout (correct but slower)",
+                    "instead of keeping the dp layout (correct but "
+                    "slower — measured pair: results/parallelism/"
+                    "train_ddp_ga2_{divisible_b16,reshard_b20}.json, "
+                    "per-token throughput in "
+                    "stats/parallelism/PARALLELISM.md)",
                     stacklevel=2,
                 )
             else:
@@ -522,20 +526,37 @@ def run_train(
                               inp["sequence_length"])
     step_flops = 3 * fwd_flops + OPTIMIZER_FLOPS_PER_PARAM.get(
         opt_name, 18) * n_params
+    # Device-work accounting under remat: full-policy remat re-runs each
+    # block's forward during backward (+1 forward of matmul FLOPs); the
+    # "dots" policy saves matmul outputs, so its recompute is elementwise
+    # only — zero extra FLOPs under this matmul-only analytic count.
+    # ``model_flops_per_step``/``achieved_tflops_per_second`` stay MODEL
+    # flops (useful work per second, comparable across remat policies);
+    # ``*_incl_recompute`` is the device-work rate.
+    recompute_flops = (
+        fwd_flops if (model_cfg.remat and model_cfg.remat_policy == "full")
+        else 0
+    )
     mean_step = float(np.mean(step_times))
+
+    from dlbb_tpu.train.optim import moments_dtype as _moments_dtype
 
     result = {
         "experiment": config.get("experiment", {}),
         "backend": "xla_tpu",
+        "config": config,
         "mode": MODE_NAMES[stage],
         "zero_stage": stage,
         "resumed_from_step": resumed_from,
         "mesh": plan.mesh_dict(),
         "learning_rate": lr,
         "optimizer": opt_name,
+        "moments_dtype": _moments_dtype(train_cfg),
         "schedule": sched_name,
         "gradient_accumulation": grad_accum,
         "pipeline_schedule": pipeline_schedule if plan.pp > 1 else None,
+        "remat": model_cfg.remat,
+        "remat_policy": model_cfg.remat_policy if model_cfg.remat else None,
         "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),
@@ -543,7 +564,16 @@ def run_train(
         "tokens_per_second": tokens / mean_step,
         "model_flops_per_step": step_flops,
         "forward_flops": fwd_flops,
+        "recompute_flops_per_step": recompute_flops,
+        "recompute_note": (
+            "achieved_tflops_per_second counts MODEL flops; with "
+            "remat_policy=full the device additionally re-runs ~1 forward "
+            "of matmuls per step (see *_incl_recompute)"
+            if recompute_flops else None
+        ),
         "achieved_tflops_per_second": step_flops / mean_step / 1e12,
+        "achieved_tflops_per_second_incl_recompute": (
+            (step_flops + recompute_flops) / mean_step / 1e12),
         **timing_meta,
         "losses": losses,
         "final_step": int(state.step),

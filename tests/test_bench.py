@@ -215,9 +215,11 @@ def test_stats_1d_granularity_marker(tmp_path):
     csv_lines = (
         tmp_path / "s" / "benchmark_statistics.csv"
     ).read_text().splitlines()
-    assert csv_lines[0].endswith("timing_granularity")
-    assert any(line.endswith("chunked(5)") for line in csv_lines[1:])
-    assert any(line.endswith("per_iteration") for line in csv_lines[1:])
+    # extension columns: granularity marker + dtype (the corpus carries
+    # the north-star curve in both bf16 and fp32)
+    assert csv_lines[0].endswith("timing_granularity,dtype")
+    assert any("chunked(5)" in line for line in csv_lines[1:])
+    assert any("per_iteration" in line for line in csv_lines[1:])
     # the full caveat text lands in the per-file stats JSON
     stats = json.loads(
         (tmp_path / "s" / "xla_test_broadcast_ranks4_1KB_stats.json")
@@ -293,6 +295,38 @@ def test_compare_1d_verdicts(tmp_path):
     assert r["speedup"] == 2.0
     assert r["verdict"] == "beat"
     assert r["raw_verdict"] == "beat"
+
+
+def test_fp32_artifacts_dtype_suffixed_and_joined(tmp_path):
+    """The fp32 half of the north-star curve: float32 sweeps write
+    dtype-suffixed filenames next to the bf16 corpus, and the comparison
+    emits one row per (config, dtype) with the dtype column filled."""
+    from dlbb_tpu.bench.runner import _result_filename
+    from dlbb_tpu.stats.compare import compare_1d
+
+    sweep32 = _tiny_1d(tmp_path, operations=("allreduce",),
+                       data_sizes=(("1KB", 256),), rank_counts=(2,),
+                       implementation="xla_tpu", dtype="float32")
+    cfg = {"operation": "allreduce", "size_label": "1KB",
+           "num_elements": 256}
+    assert _result_filename(sweep32, "xla_tpu", 2, cfg) \
+        == "xla_tpu_allreduce_ranks2_1KB_fp32.json"
+    run_sweep(sweep32, verbose=False)
+    out = tmp_path / "results" / "xla_tpu_allreduce_ranks2_1KB_fp32.json"
+    assert out.exists()
+    assert json.loads(out.read_text())["dtype"] == "float32"
+
+    ref = tmp_path / "ref"
+    _write_1d_artifact(ref / "fast" / "a.json", "fast", "allreduce", 2,
+                       "1KB", 256, 1e-3)
+    own = tmp_path / "own"
+    _write_1d_artifact(own / "a.json", "xla_tpu", "allreduce", 2,
+                       "1KB", 256, 1e-3)
+    art32 = json.loads(out.read_text())
+    (own / "a_fp32.json").write_text(json.dumps(art32))
+    rows = compare_1d(ref, own)
+    assert len(rows) == 2
+    assert {r["xla_dtype"] for r in rows} == {"bfloat16", "float32"}
 
 
 def test_compare_1d_simulated_rows_are_not_comparable(tmp_path):

@@ -213,6 +213,18 @@ def test_remat_matches_no_remat(devices):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-5, atol=1e-6)
 
+    # the "dots" policy (save matmul outputs, recompute elementwise only)
+    # is a scheduling choice, never a numerics choice
+    dots_cfg = TINY.with_(remat=True, remat_policy="dots")
+    g_dots = jax.jit(
+        lambda p, x, t: jax.grad(mse_loss)(p, x, t, dots_cfg)
+    )(params, x, t)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_dots)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError, match="remat_policy"):
+        TINY.with_(remat_policy="selective??")
+
 
 def test_forward_flops_accounting():
     """Analytic FLOPs: spot-check the dense formula and the mode

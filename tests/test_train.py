@@ -178,3 +178,41 @@ def test_opt_state_specs_scalar_replicated(devices):
         jax.tree.leaves(opt_state),
     ) if getattr(l, "ndim", None) == 0]
     assert all(s == P() for s in counts)
+
+
+def test_parallelism_report(tmp_path):
+    """The parallelism-family comparison joins train artifacts per family,
+    ranks by per-token throughput (fair when members run unequal batches,
+    e.g. the grad-accum reshard pair), and lists missing members with null
+    times instead of dropping them."""
+    import json
+
+    from dlbb_tpu.stats.parallelism_report import write_parallelism_report
+
+    def art(name, mean_s, tokens_per_s):
+        (tmp_path / f"train_ddp_{name}.json").write_text(json.dumps({
+            "experiment": {"name": name},
+            "mesh": {"dp": 2, "sp": 1, "pp": 2, "ep": 1, "tp": 2},
+            "step_time": {"mean": mean_s},
+            "tokens_per_second": tokens_per_s,
+        }))
+
+    art("pp2_gpipe", 0.10, 1000.0)
+    art("pp2_1f1b", 0.08, 1250.0)
+    art("ga2_divisible_b16", 0.10, 2000.0)
+    art("ga2_reshard_b20", 0.15, 1600.0)  # bigger batch, worse per token
+    families = {
+        "pipeline_schedule": ["pp2_gpipe", "pp2_1f1b"],
+        "grad_accum_reshard": ["ga2_divisible_b16", "ga2_reshard_b20"],
+        "context_parallel": ["sp2_ring", "sp2_ulysses"],  # missing
+    }
+    rows = write_parallelism_report(tmp_path, tmp_path / "out", families)
+    by = {r["member"]: r for r in rows}
+    assert by["pp2_1f1b"]["winner"] is True
+    assert by["pp2_gpipe"]["winner"] is False
+    assert by["pp2_gpipe"]["slowdown_vs_winner"] == 1.25
+    assert by["ga2_divisible_b16"]["winner"] is True
+    assert by["ga2_reshard_b20"]["slowdown_vs_winner"] == 1.25
+    assert by["sp2_ring"]["step_time_mean_s"] is None  # listed, not dropped
+    assert (tmp_path / "out" / "PARALLELISM.md").exists()
+    assert (tmp_path / "out" / "parallelism_comparison.csv").exists()
