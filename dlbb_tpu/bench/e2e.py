@@ -114,7 +114,8 @@ def run_e2e(
                 "timing_method": "time.perf_counter() + jax.block_until_ready()",
             }
         else:
-            forward_times, timing_meta = time_fn_chained(
+            # batch is donated to the timing loop; it is not used again
+            forward_times, timing_meta, _ = time_fn_chained(
                 step, batch, warmup=1, iterations=iters,
                 chunk_size=min(5, iters), op_args=(params,),
                 compiler_options=comp_opts or None,

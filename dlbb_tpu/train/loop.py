@@ -505,7 +505,11 @@ def run_train(
             return new_state
 
         with annotate("measure"):
-            step_times, timing_meta = time_fn_chained(
+            # state is donated to the timing loop (halves resident
+            # TrainState HBM — decisive for Adam at 1B on the 16 GiB
+            # chip); the returned carry IS the post-timing state and
+            # everything below (final ckpt save, final_step) uses it
+            step_times, timing_meta, state = time_fn_chained(
                 timed_step, state, warmup=1, iterations=iters,
                 chunk_size=min(5, iters), op_args=(batch, tgt),
                 compiler_options=comp_opts or None,

@@ -181,7 +181,7 @@ def time_fn_chained(
     op_args: tuple = (),
     compiler_options: Optional[dict[str, str]] = None,
     max_seconds: Optional[float] = None,
-) -> tuple[list[float], dict[str, Any]]:
+) -> tuple[list[float], dict[str, Any], Any]:
     """Chunked fori_loop timing (remote-async backends).
 
     ``op`` is invoked as ``op(*op_args, carry)``.  Anything large the op
@@ -189,9 +189,12 @@ def time_fn_chained(
     closed over by the jitted loop are embedded as compile-time constants,
     which at model scale stalls compilation indefinitely.
 
-    Returns ``(samples, meta)``: each sample is the estimated per-iteration
-    time of one chunk, ``(chunk_wall - fetch_overhead) / chunk_size``;
-    ``len(samples) == iterations // chunk_size`` (≥ 1).
+    Returns ``(samples, meta, carry)``: each sample is the estimated
+    per-iteration time of one chunk, ``(chunk_wall - fetch_overhead) /
+    chunk_size``; ``len(samples) == iterations // chunk_size`` (≥ 1).
+    The input ``x`` is DONATED to the loop (see the comment at the jit
+    below) — callers must use the returned final ``carry`` instead of
+    ``x`` afterwards.
     """
     if chunk_size is None:
         chunk_size = max(1, min(10, iterations // 10 or 1))
@@ -201,10 +204,17 @@ def time_fn_chained(
         out = op(*args, c)
         return chain(out) if chain is not None else out
 
+    # the carry (x0) is DONATED: chained timing feeds each chunk's output
+    # back as the next chunk's input anyway, and without donation XLA must
+    # keep input and output carries simultaneously resident — at train-step
+    # scale (TrainState = params + Adam moments) that doubles state HBM and
+    # OOMs configs whose training loop itself fits (measured: 1B/b8/s512
+    # Adam-bf16m trains, then OOMed in this timing loop before the fix)
     looped = jax.jit(
         lambda args, x0: jax.lax.fori_loop(
             0, chunk_size, lambda i, c: body(args, c), x0
-        )
+        ),
+        donate_argnums=(1,),
     )
     if compiler_options:
         # variant-tuned compilation (e.g. combiner passes disabled) — the
@@ -216,7 +226,8 @@ def time_fn_chained(
     warm_wall = float("inf")
     for _ in range(max(1, warmup)):
         t0 = time.perf_counter()
-        _force(looped(op_args, x))
+        x = looped(op_args, x)  # rebind: the donated input is now invalid
+        _force(x)
         warm_wall = min(warm_wall, time.perf_counter() - t0)
     overhead = calibrate_fetch_overhead(x)
 
@@ -229,7 +240,8 @@ def time_fn_chained(
     samples = []
     for _ in range(chunks):
         t0 = time.perf_counter()
-        _force(looped(op_args, x))
+        x = looped(op_args, x)
+        _force(x)
         wall = time.perf_counter() - t0
         samples.append(max(wall - overhead, 0.0) / chunk_size)
     meta = {
@@ -255,7 +267,7 @@ def time_fn_chained(
             time_budget_s=max_seconds,
             time_budget_clamped=True,
         )
-    return samples, meta
+    return samples, meta, x
 
 
 def time_collective(
@@ -321,7 +333,7 @@ def time_collective(
                     "return on enqueue; switching to chained timing",
                     stacklevel=2,
                 )
-                samples, cmeta = time_fn_chained(
+                samples, cmeta, _ = time_fn_chained(
                     op, x, chain=chain, warmup=1, iterations=iterations,
                     compiler_options=compiler_options,
                     max_seconds=max_seconds,
@@ -341,8 +353,9 @@ def time_collective(
                 time_budget_clamped=True,
             )
         return timings, meta
-    return time_fn_chained(
+    samples, cmeta, _ = time_fn_chained(
         op, x, chain=chain, warmup=max(1, warmup // 10),
         iterations=iterations, compiler_options=compiler_options,
         max_seconds=max_seconds,
     )
+    return samples, cmeta

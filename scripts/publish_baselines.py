@@ -723,12 +723,27 @@ def stage_baseline() -> None:
         ladder = {}
         for p in sorted(train_dir.glob("train_*.json")):
             r = json.loads(p.read_text())
-            ladder[r["experiment"]["name"]] = {
+            name = r["experiment"]["name"]
+            if r.get("status") == "infeasible":
+                # capability boundaries (e.g. the no-remat rung) publish
+                # their reason, never shadow a measured artifact
+                ladder.setdefault(
+                    name, {"status": "infeasible", "reason": r["reason"]}
+                )
+                continue
+            entry = {
                 "step_time_mean_s": r["step_time"]["mean"],
                 "tokens_per_second": r["tokens_per_second"],
                 "achieved_tflops_per_second":
                     r["achieved_tflops_per_second"],
             }
+            if r.get("achieved_tflops_per_second_incl_recompute") is not None:
+                entry["achieved_tflops_per_second_incl_recompute"] = (
+                    r["achieved_tflops_per_second_incl_recompute"])
+            sysinfo = r.get("system_info", {})
+            if sysinfo.get("backend") == "cpu":
+                entry["simulated"] = True
+            ladder[name] = entry
         published["train_zero_ladder"] = ladder
     data["published"] = published
     baseline_path.write_text(json.dumps(data, indent=2) + "\n")

@@ -62,9 +62,11 @@ def test_chained_meta_has_percentile_caveat(devices):
     (VERDICT r1 weak #4: percentiles over chunk means, not tails)."""
     x = jnp.ones((64, 64))
     f = jax.jit(lambda a: a @ a)
-    samples, meta = time_fn_chained(f, x, warmup=1, iterations=10,
-                                    chunk_size=5)
+    samples, meta, carry = time_fn_chained(f, x, warmup=1, iterations=10,
+                                           chunk_size=5)
     assert "chunk means" in meta["percentile_caveat"]
+    # x was donated; the returned carry is live and has the input's shape
+    assert carry.shape == (64, 64)
     assert meta["timing_mode"] == "chained"
     assert len(samples) == 2
 
@@ -74,7 +76,7 @@ def test_chained_max_seconds_clamps_chunks(devices):
     chunk count shrinks and the clamp is recorded."""
     x = jnp.ones((512, 512))
     f = jax.jit(lambda a: a @ a)
-    samples, meta = time_fn_chained(
+    samples, meta, _ = time_fn_chained(
         f, x, warmup=1, iterations=10_000, chunk_size=10,
         max_seconds=0.02,
     )
