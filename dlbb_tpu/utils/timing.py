@@ -282,6 +282,11 @@ def time_collective(
 ) -> tuple[list[float], dict[str, Any]]:
     """Unified entry: returns (per-iteration timings, metadata).
 
+    In chained mode (remote-async backends, incl. the per-iter
+    implausibility fallback) ``x`` is DONATED to the timing loop and must
+    not be touched by the caller afterwards — the sweep driver builds a
+    fresh payload per config, so nothing here returns the carry.
+
     ``max_seconds`` bounds the measurement wall time per config (slow hosts /
     huge payloads): iteration counts are scaled down to fit and the *actual*
     counts land in the metadata, overriding the sweep's nominal ones in the

@@ -121,12 +121,8 @@ def write_boundary_artifact(suffix: str, output: str, exit_code: int,
 
 
 def _run_one(suffix: str, iters: int, output: str) -> None:
-    import jax
-
-    print(f"devices: {jax.devices()}", flush=True)
-
-    from dlbb_tpu.train.loop import run_train
-
+    # validate the suffix BEFORE any JAX/runtime init: a typo must fail in
+    # milliseconds, not after grabbing the chip
     match = [(t, m) for s, t, m in CONFIGS if s == suffix]
     if not match:
         raise SystemExit(
@@ -134,6 +130,12 @@ def _run_one(suffix: str, iters: int, output: str) -> None:
             f"{[s for s, _, _ in CONFIGS]}"
         )
     training, model_over = match[0]
+
+    import jax
+
+    print(f"devices: {jax.devices()}", flush=True)
+
+    from dlbb_tpu.train.loop import run_train
     config = {
         "experiment": {"name": _experiment_name(suffix)},
         "model": {"size": "1B", "attention": "full", **model_over},

@@ -120,3 +120,73 @@ def test_boundary_reason_computed_from_config(monkeypatch, tmp_path):
     # 7B: 32 heads; 8 * 32 * 4096^2 * 4 B = 16 GiB
     assert "N=32" in reason7b and "S=4096" in reason7b
     assert "16 GiB fp32" in reason7b
+
+
+def _load_train(monkeypatch, tmp_path, run_results):
+    """Import publish_tpu_train with subprocess.run faked.
+
+    ``run_results``: {suffix: (returncode, stderr)}; absent configs
+    succeed."""
+    spec = importlib.util.spec_from_file_location(
+        "publish_tpu_train", REPO / "scripts" / "publish_tpu_train.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    calls = []
+
+    def fake_run(cmd, capture_output=True, text=True):
+        suffix = cmd[cmd.index("--only") + 1]
+        calls.append(suffix)
+        rc, stderr = run_results.get(suffix, (0, ""))
+        return types.SimpleNamespace(
+            returncode=rc, stdout=f"ran {suffix}\n", stderr=stderr
+        )
+
+    import subprocess
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(
+        sys, "argv", ["publish_tpu_train.py", "--output", str(tmp_path)]
+    )
+    return mod, calls
+
+
+def test_train_boundary_only_for_remat_off(monkeypatch, tmp_path):
+    """sgd_remat_off's memory failure is the no-remat ladder point; the
+    boundary artifact records a reason computed from the 1B geometry."""
+    mod, calls = _load_train(
+        monkeypatch, tmp_path,
+        {"sgd_remat_off": (1, "XLA ... RESOURCE_EXHAUSTED hbm\n")},
+    )
+    assert mod.main() == 0
+    art = tmp_path / "train_ddp_1B_train_chip_sgd_remat_off_infeasible.json"
+    data = json.loads(art.read_text())
+    assert data["status"] == "infeasible"
+    assert "remat" in data["reason"]
+    # every other config ran
+    assert set(calls) == {s for s, _, _ in mod.CONFIGS}
+
+
+def test_train_adam_fp32m_failure_is_real(monkeypatch, tmp_path):
+    """adam_fp32m is measured since the timing-loop donation fix; an OOM
+    there is a regression, never silently recorded as infeasible."""
+    mod, _ = _load_train(
+        monkeypatch, tmp_path,
+        {"adam_fp32m": (1, "RESOURCE_EXHAUSTED\n")},
+    )
+    assert mod.main() == 1
+    assert not list(tmp_path.glob("*adam_fp32m*_infeasible.json"))
+
+
+def test_train_unknown_only_suffix_rejected(monkeypatch, tmp_path):
+    mod, _ = _load_train(monkeypatch, tmp_path, {})
+    monkeypatch.setattr(
+        sys, "argv",
+        ["publish_tpu_train.py", "--output", str(tmp_path),
+         "--only", "adam_bf16"],
+    )
+    import pytest
+
+    with pytest.raises(SystemExit, match="unknown config"):
+        mod.main()
