@@ -29,6 +29,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # _publish_common
 
 CONFIGS = (
     ("1B", "simplified", 512),
@@ -54,9 +55,6 @@ CONFIGS = (
 # error signature, a *_infeasible.json boundary artifact is written and the
 # run continues; any OTHER failure there still counts as a real failure.
 EXPECTED_FAIL_OK = {("1B", "dense", 8192)}
-
-# error signatures that qualify a failure as the memory boundary
-_BOUNDARY_SIGNATURES = ("RESOURCE_EXHAUSTED", "remote_compile", "Allocat")
 
 
 BATCH_SIZE = 8  # every config in this script runs at B=8 (see _run_one)
@@ -161,49 +159,20 @@ def main() -> int:
     # running the whole set in-process accumulates enough leftover
     # allocations that the 7B configs hit RESOURCE_EXHAUSTED on the 16 GB
     # chip after the three 1B models have run.
-    import subprocess
+    from _publish_common import run_worker_matrix
 
-    failures = []
-    for size, attention, seq in CONFIGS:
-        cmd = [sys.executable, __file__, "--iters", str(args.iters),
-               "--output", args.output, "--only",
-               f"{size},{attention},{seq}"]
-        r = subprocess.run(cmd, capture_output=True, text=True)
-        sys.stdout.write(r.stdout)
-        if r.returncode == 0:
-            # a previously-infeasible config that now measures cleanly
-            # must not leave a stale boundary artifact shadowing it
-            name = _artifact_name(size, attention, seq)
-            stale = Path(args.output) / f"{name}_infeasible.json"
-            stale.unlink(missing_ok=True)
-            continue
-        err_lines = [l for l in r.stderr.splitlines() if l.strip()]
-        observed = err_lines[-1] if err_lines else f"exit {r.returncode}"
-        is_boundary = (
-            (size, attention, seq) in EXPECTED_FAIL_OK
-            and any(sig in r.stderr for sig in _BOUNDARY_SIGNATURES)
-        )
-        if is_boundary:
-            # a config that regressed to infeasible must not leave its
-            # stale measured artifact shadowing the fresh boundary file
-            # (the mirror of the stale-boundary unlink above)
-            name = _artifact_name(size, attention, seq)
-            stale = Path(args.output) / f"{name}.json"
-            stale.unlink(missing_ok=True)
-            write_boundary_artifact(size, attention, seq, args.output,
-                                    r.returncode, observed)
-            print(f"EXPECTED-INFEASIBLE {size}/{attention}/s{seq} "
-                  "(boundary artifact written)", flush=True)
-            continue
-        sys.stderr.write(r.stderr)
-        print(f"FAILED {size}/{attention}/s{seq} "
-              f"(exit {r.returncode})", flush=True)
-        failures.append((size, attention, seq))
-    if failures:
-        print(f"{len(failures)} config(s) failed: {failures}", flush=True)
-        return 1
-    print(f"artifacts in {args.output}", flush=True)
-    return 0
+    return run_worker_matrix(
+        __file__,
+        list(CONFIGS),
+        only_str=lambda c: f"{c[0]},{c[1]},{c[2]}",
+        artifact_name=lambda c: _artifact_name(*c),
+        expected_fail_ok=EXPECTED_FAIL_OK,
+        write_boundary=lambda c, out, rc, obs: write_boundary_artifact(
+            *c, out, rc, obs),
+        output=args.output,
+        iters=args.iters,
+        label=lambda c: f"{c[0]}/{c[1]}/s{c[2]}",
+    )
 
 
 if __name__ == "__main__":

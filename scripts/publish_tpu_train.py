@@ -2,9 +2,11 @@
 """Publish the real-TPU-chip TRAIN artifact set under ``results/train/``.
 
 The train-side analogue of ``publish_tpu_e2e.py`` — and the provenance
-record for every ``*_chip_*`` train artifact (round 3's two chip artifacts
-were produced ad hoc; this script reproduces and extends them).  Covers the
-two round-4 asks:
+record for every ``*_chip_*`` train artifact: every committed
+``results/train/train_ddp_1B_train_chip_*.json`` has a matching suffix in
+``CONFIGS`` (round 3's ad-hoc ``sgd`` artifact was superseded by the
+``sgd_remat_full`` config, which measures the identical configuration
+with provenance).  Covers the two round-4 asks:
 
 - **the reference's optimizer on the chip**: the reference trains only
   with Adam (``/root/reference/test/ccl.py:74-117``,
@@ -31,6 +33,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(Path(__file__).resolve().parent))  # _publish_common
 
 # (name_suffix, training overrides, model overrides)
 CONFIGS: tuple[tuple[str, dict, dict], ...] = (
@@ -54,6 +57,9 @@ CONFIGS: tuple[tuple[str, dict, dict], ...] = (
     ("adam_bf16m_dots",
      {"optimizer": "adam", "moments_dtype": "bfloat16"},
      {"remat": True, "remat_policy": "dots"}),
+    # the TPU-idiomatic large-model optimizer (factored second moments)
+    ("adafactor", {"optimizer": "adafactor"},
+     {"remat": True, "remat_policy": "full"}),
 )
 
 # sgd_remat_off: the no-remat rung of the ladder — measured OOM at compile
@@ -67,8 +73,6 @@ CONFIGS: tuple[tuple[str, dict, dict], ...] = (
 # measures cleanly (results/train/train_ddp_1B_train_chip_adam_fp32m.json),
 # so a failure there is a real regression again.
 EXPECTED_FAIL_OK = {"sgd_remat_off"}
-
-_BOUNDARY_SIGNATURES = ("RESOURCE_EXHAUSTED", "remote_compile", "Allocat")
 
 BATCH_SIZE = 8
 SEQ_LEN = 512
@@ -162,43 +166,18 @@ def main() -> int:
         _run_one(args.only, args.iters, args.output)
         return 0
 
-    # one subprocess per config: fresh HBM arena per measurement (same
-    # rationale as publish_tpu_e2e.py)
-    import subprocess
+    from _publish_common import run_worker_matrix
 
-    failures = []
-    for suffix, _, _ in CONFIGS:
-        cmd = [sys.executable, __file__, "--iters", str(args.iters),
-               "--output", args.output, "--only", suffix]
-        r = subprocess.run(cmd, capture_output=True, text=True)
-        sys.stdout.write(r.stdout)
-        if r.returncode == 0:
-            stale = (Path(args.output)
-                     / f"{_artifact_name(suffix)}_infeasible.json")
-            stale.unlink(missing_ok=True)
-            continue
-        err_lines = [l for l in r.stderr.splitlines() if l.strip()]
-        observed = err_lines[-1] if err_lines else f"exit {r.returncode}"
-        is_boundary = (
-            suffix in EXPECTED_FAIL_OK
-            and any(sig in r.stderr for sig in _BOUNDARY_SIGNATURES)
-        )
-        if is_boundary:
-            stale = Path(args.output) / f"{_artifact_name(suffix)}.json"
-            stale.unlink(missing_ok=True)
-            write_boundary_artifact(suffix, args.output, r.returncode,
-                                    observed)
-            print(f"EXPECTED-INFEASIBLE {suffix} "
-                  "(boundary artifact written)", flush=True)
-            continue
-        sys.stderr.write(r.stderr)
-        print(f"FAILED {suffix} (exit {r.returncode})", flush=True)
-        failures.append(suffix)
-    if failures:
-        print(f"{len(failures)} config(s) failed: {failures}", flush=True)
-        return 1
-    print(f"artifacts in {args.output}", flush=True)
-    return 0
+    return run_worker_matrix(
+        __file__,
+        [s for s, _, _ in CONFIGS],
+        only_str=lambda s: s,
+        artifact_name=_artifact_name,
+        expected_fail_ok=EXPECTED_FAIL_OK,
+        write_boundary=write_boundary_artifact,
+        output=args.output,
+        iters=args.iters,
+    )
 
 
 if __name__ == "__main__":
