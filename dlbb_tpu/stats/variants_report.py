@@ -181,3 +181,99 @@ def write_variants_report(
             for n, (_, w, s, _) in sorted(per_rank.items())
         },
     }
+
+
+def write_variants3d_report(
+    variants3d_stats_root: Path,
+    base_3d_stats_csv: Path,
+    out_dir: Path,
+    operation: str = "allreduce",
+) -> list[dict[str, Any]]:
+    """3D-shape comparison of the tuned variants against the default
+    corpus — the reference tuned its CCL algorithms on the 3D LLM-shaped
+    sweep (``collectives/3d/launch_dsccl.sh``), so the 1D winners get the
+    same treatment.  Joins each ``stats/variants3d/<impl>/...standard.csv``
+    with the default 3D stats per (op, ranks, batch, seq, hidden); emits
+    ``VARIANTS3D.md`` + ``variants3d_comparison.csv``; returns the rows."""
+    impls: dict[str, dict[tuple, float]] = {}
+
+    def read_standard(csv_path: Path, impl: str) -> dict[tuple, float]:
+        out: dict[tuple, float] = {}
+        with csv_path.open() as f:
+            for r in csv.DictReader(f):
+                # filter on the implementation column too: a combined CSV
+                # must not silently merge other impls under this name
+                if (r["operation"] != operation
+                        or r.get("implementation", impl) != impl):
+                    continue
+                key = (int(r["num_ranks"]), int(r["batch"]),
+                       int(r["seq_len"]), int(r["hidden_dim"]))
+                out[key] = float(r["mean_time_ms"])
+        return out
+
+    base_3d_stats_csv = Path(base_3d_stats_csv)
+    if base_3d_stats_csv.exists():
+        impls["xla_tpu"] = read_standard(base_3d_stats_csv, "xla_tpu")
+    root = Path(variants3d_stats_root)
+    if root.is_dir():
+        for impl_dir in sorted(root.iterdir()):
+            std = sorted(impl_dir.glob("*_standard.csv"))
+            if not impl_dir.is_dir() or not std:
+                continue
+            if len(std) > 1:
+                raise ValueError(
+                    f"{impl_dir} holds {len(std)} *_standard.csv files — "
+                    "ambiguous input; remove the stale one"
+                )
+            impls[impl_dir.name] = read_standard(std[0], impl_dir.name)
+    if not impls:
+        return []
+
+    names = sorted(impls)
+    keys = sorted(set().union(*[set(v) for v in impls.values()]))
+    rows: list[dict[str, Any]] = []
+    for key in keys:
+        present = {n: impls[n][key] for n in names if key in impls[n]}
+        if len(present) < 2:
+            continue  # a comparison needs at least two columns
+        row: dict[str, Any] = {
+            "num_ranks": key[0], "batch": key[1], "seq_len": key[2],
+            "hidden_dim": key[3],
+        }
+        for n in names:
+            row[n] = round(present[n], 4) if n in present else None
+        winner = min(present, key=present.get)  # type: ignore[arg-type]
+        row["winner"] = winner
+        base = present.get("xla_tpu")
+        row["winner_speedup_vs_default"] = (
+            round(base / present[winner], 4) if base else None
+        )
+        rows.append(row)
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    columns = ["num_ranks", "batch", "seq_len", "hidden_dim", *names,
+               "winner", "winner_speedup_vs_default"]
+    with (out_dir / "variants3d_comparison.csv").open("w", newline="") as f:
+        w = csv.DictWriter(f, fieldnames=columns)
+        w.writeheader()
+        w.writerows(rows)
+    from dlbb_tpu.stats.compare import md_table
+
+    wins = {n: sum(1 for r in rows if r["winner"] == n) for n in names}
+    md = [
+        f"# 3D-shape variant comparison — {operation} "
+        "(mean ms per config)",
+        "",
+        "The two 1D-winning tuning variants measured on the reference's "
+        "3D LLM-shaped sweep grid, against the default-variant corpus "
+        "(`results/3d/xla_tpu`) — the analogue of the reference tuning "
+        "its CCL algorithms on the 3D shape "
+        "(`collectives/3d/launch_dsccl.sh`).  Wins per variant: "
+        + ", ".join(f"{n}: {wins[n]}" for n in names) + ".",
+        "",
+    ]
+    md += md_table(rows, columns)
+    md.append("")
+    (out_dir / "VARIANTS3D.md").write_text("\n".join(md))
+    return rows

@@ -610,6 +610,17 @@ def stage_stats() -> None:
         vs = (f"{w['speedup_vs_default']}x vs default"
               if w["speedup_vs_default"] is not None else "no default row")
         log(f"  variants {size}: {w['winner']} ({vs})")
+    from dlbb_tpu.stats.variants_report import write_variants3d_report
+
+    rows3d = write_variants3d_report(
+        STATS / "variants3d",
+        STATS / "3d" / "xla_tpu"
+        / "benchmark_statistics_3d_xla_tpu_standard.csv",
+        STATS / "variants3d",
+    )
+    if rows3d:
+        log(f"  variants3d: {len(rows3d)} joined configs "
+            f"(stats/variants3d/VARIANTS3D.md)")
 
 
 def stage_compare() -> None:

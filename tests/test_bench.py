@@ -493,3 +493,45 @@ def test_stats_reads_reference_artifact(tmp_path):
     # fp16 element size resolved from the numpy-repr dtype string
     expected_bw = 256 * 2 * 4 / (1.2e-4) / 2**30
     np.testing.assert_allclose(results[0]["bandwidth_gbps"], expected_bw, rtol=1e-9)
+
+
+def test_variants3d_report(tmp_path):
+    """3D-shape variant comparison: joins variant standard CSVs with the
+    default 3D corpus per config, picks the winner, and drops configs only
+    one implementation measured."""
+    import csv as _csv
+
+    from dlbb_tpu.stats.variants_report import write_variants3d_report
+
+    cols = ["implementation", "operation", "num_ranks", "hidden_dim",
+            "seq_len", "batch", "tensor_size_mb", "num_elements",
+            "mean_time_ms", "median_time_ms", "min_time_ms", "max_time_ms"]
+
+    def std_csv(path, impl, rows):
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("w", newline="") as f:
+            w = _csv.DictWriter(f, fieldnames=cols)
+            w.writeheader()
+            for ranks, b, s, h, mean in rows:
+                w.writerow({
+                    "implementation": impl, "operation": "allreduce",
+                    "num_ranks": ranks, "hidden_dim": h, "seq_len": s,
+                    "batch": b, "tensor_size_mb": 1, "num_elements": 1,
+                    "mean_time_ms": mean, "median_time_ms": mean,
+                    "min_time_ms": mean, "max_time_ms": mean,
+                })
+
+    base = tmp_path / "3d" / "base_standard.csv"
+    std_csv(base, "xla_tpu", [(8, 1, 2048, 2048, 10.0),
+                              (8, 8, 2048, 2048, 80.0)])
+    std_csv(tmp_path / "v3d" / "xla_tpu_ring" / "r_standard.csv",
+            "xla_tpu_ring", [(8, 1, 2048, 2048, 5.0),
+                             (4, 1, 1, 2048, 1.0)])  # ranks-4: ring only
+    rows = write_variants3d_report(tmp_path / "v3d", base,
+                                   tmp_path / "out")
+    assert len(rows) == 1  # the single config both measured
+    r = rows[0]
+    assert r["winner"] == "xla_tpu_ring"
+    assert r["winner_speedup_vs_default"] == 2.0
+    assert (tmp_path / "out" / "VARIANTS3D.md").exists()
+    assert (tmp_path / "out" / "variants3d_comparison.csv").exists()
