@@ -86,6 +86,16 @@ def build_parser() -> argparse.ArgumentParser:
     e2.add_argument("--output", default=None)
     _add_trace(e2)
 
+    rp = sub.add_parser(
+        "reports",
+        help="regenerate the derived comparison reports (variant tuning "
+             "1D + 3D winners, parallelism families) from committed "
+             "results/ + stats/ — pure file processing, no backend",
+    )
+    rp.add_argument("--stats", default="stats", help="stats tree root")
+    rp.add_argument("--results", default="results",
+                    help="results tree root (parallelism artifacts)")
+
     tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
     tr.add_argument("--simulate", type=int, default=0, metavar="N")
@@ -235,6 +245,58 @@ def _dispatch(args) -> int:
             print(f"{dim}: {s['configs']} configs — {s['beat']} beat, "
                   f"{s['match']} match, {s['lose']} lose")
         print(f"report written to {args.output}/COMPARISON.md")
+        return 0
+
+    if args.cmd == "reports":
+        from pathlib import Path
+
+        from dlbb_tpu.stats import write_variants_report
+        from dlbb_tpu.stats.parallelism_report import (
+            DEFAULT_FAMILIES,
+            write_parallelism_report,
+        )
+        from dlbb_tpu.stats.variants_report import write_variants3d_report
+
+        stats_root, results_root = Path(args.stats), Path(args.results)
+        produced = 0
+        summary = write_variants_report(stats_root / "variants")
+        if summary["winners"]:
+            produced += 1
+            print(f"variants: {len(summary['winners'])} sizes across rank "
+                  f"counts {sorted(summary.get('ranks', {}))} -> "
+                  f"{stats_root / 'variants' / 'VARIANTS.md'}")
+        else:
+            print(f"variants: no stats under {stats_root / 'variants'} — "
+                  "skipped")
+        rows3d = write_variants3d_report(stats_root / "variants3d")
+        if rows3d:
+            produced += 1
+            print(f"variants3d: {len(rows3d)} joined configs -> "
+                  f"{stats_root / 'variants3d' / 'VARIANTS3D.md'}")
+        else:
+            print(f"variants3d: no stats under "
+                  f"{stats_root / 'variants3d'} — skipped")
+        # only (re)write the parallelism report when its input artifacts
+        # exist: a typo'd --results must not clobber the committed report
+        # with an all-null table
+        par_dir = results_root / "parallelism"
+        if any(par_dir.glob("train_*.json")):
+            rows = write_parallelism_report(
+                par_dir, stats_root / "parallelism", DEFAULT_FAMILIES,
+            )
+            measured = [
+                r for r in rows if r["step_time_mean_s"] is not None
+            ]
+            produced += 1
+            print(f"parallelism: {len(measured)} measured members -> "
+                  f"{stats_root / 'parallelism' / 'PARALLELISM.md'}")
+        else:
+            print(f"parallelism: no train_*.json under {par_dir} — "
+                  "skipped")
+        if produced == 0:
+            print("error: nothing to report — check --stats/--results "
+                  "point at the committed trees")
+            return 1
         return 0
 
     if args.cmd == "e2e":

@@ -29,6 +29,20 @@ COLUMNS = [
     "tokens_per_second", "winner", "slowdown_vs_winner",
 ]
 
+# The benchmark matrix: each family is a pair identical except for the
+# axis under test.  Single source of truth for the artifact producer
+# (scripts/publish_baselines.py stage "parallelism") and the report CLI.
+DEFAULT_FAMILIES: dict[str, list[str]] = {
+    "pipeline_schedule": ["pp2_gpipe", "pp2_1f1b"],
+    "context_parallel": ["sp2_ring", "sp2_ulysses"],
+    "moe_dispatch": ["ep2_moe_dense", "ep2_moe_capacity"],
+    # the reshard cost behind train/loop.py's grad-accum x dp warning:
+    # same model/mesh/grad_accum, batch 16 keeps micro-batches divisible
+    # by dp=4, batch 20 forces the per-micro-step reshard — per-TOKEN
+    # throughput is the comparison (batches differ by construction)
+    "grad_accum_reshard": ["ga2_divisible_b16", "ga2_reshard_b20"],
+}
+
 
 def collect_family_rows(
     results_dir: Path, families: dict[str, list[str]]

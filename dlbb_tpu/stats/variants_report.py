@@ -185,8 +185,8 @@ def write_variants_report(
 
 def write_variants3d_report(
     variants3d_stats_root: Path,
-    base_3d_stats_csv: Path,
-    out_dir: Path,
+    base_3d_stats_csv: Optional[Path] = None,
+    out_dir: Optional[Path] = None,
     operation: str = "allreduce",
 ) -> list[dict[str, Any]]:
     """3D-shape comparison of the tuned variants against the default
@@ -194,7 +194,20 @@ def write_variants3d_report(
     sweep (``collectives/3d/launch_dsccl.sh``), so the 1D winners get the
     same treatment.  Joins each ``stats/variants3d/<impl>/...standard.csv``
     with the default 3D stats per (op, ranks, batch, seq, hidden); emits
-    ``VARIANTS3D.md`` + ``variants3d_comparison.csv``; returns the rows."""
+    ``VARIANTS3D.md`` + ``variants3d_comparison.csv``; returns the rows.
+
+    ``base_3d_stats_csv`` defaults to the sibling default-corpus stats
+    (``<stats root>/3d/xla_tpu/...standard.csv``) so the artifact producer
+    and the ``reports`` CLI cannot drift on the path; ``out_dir`` defaults
+    to the variants3d root itself."""
+    root = Path(variants3d_stats_root)
+    if base_3d_stats_csv is None:
+        base_3d_stats_csv = (
+            root.parent / "3d" / "xla_tpu"
+            / "benchmark_statistics_3d_xla_tpu_standard.csv"
+        )
+    if out_dir is None:
+        out_dir = root
     impls: dict[str, dict[tuple, float]] = {}
 
     def read_standard(csv_path: Path, impl: str) -> dict[tuple, float]:
@@ -214,7 +227,6 @@ def write_variants3d_report(
     base_3d_stats_csv = Path(base_3d_stats_csv)
     if base_3d_stats_csv.exists():
         impls["xla_tpu"] = read_standard(base_3d_stats_csv, "xla_tpu")
-    root = Path(variants3d_stats_root)
     if root.is_dir():
         for impl_dir in sorted(root.iterdir()):
             std = sorted(impl_dir.glob("*_standard.csv"))
@@ -224,6 +236,15 @@ def write_variants3d_report(
                 raise ValueError(
                     f"{impl_dir} holds {len(std)} *_standard.csv files — "
                     "ambiguous input; remove the stale one"
+                )
+            if impl_dir.name in impls:
+                # a dir named "xla_tpu" would silently shadow the
+                # default-corpus baseline — same ambiguity class as the
+                # duplicate-CSV check above
+                raise ValueError(
+                    f"{impl_dir} would shadow the already-loaded "
+                    f"{impl_dir.name!r} corpus (baseline comes from "
+                    f"{base_3d_stats_csv})"
                 )
             impls[impl_dir.name] = read_standard(std[0], impl_dir.name)
     if not impls:
@@ -246,7 +267,8 @@ def write_variants3d_report(
         row["winner"] = winner
         base = present.get("xla_tpu")
         row["winner_speedup_vs_default"] = (
-            round(base / present[winner], 4) if base else None
+            round(base / present[winner], 4)
+            if base is not None and present[winner] > 0 else None
         )
         rows.append(row)
 

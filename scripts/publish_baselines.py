@@ -407,21 +407,14 @@ def stage_train() -> None:
             run_train(config, zero_stage=stage, output_dir=str(out))
 
 
-# Parallelism-family benchmark matrix (VERDICT r3 missing #4): each family
-# is a pair identical except for the axis under test.  Model is the small
-# train-stage geometry so the simulated mesh measures schedules, not
-# host-core matmul throughput.  Sequence length 128 gives the sp familes a
-# real sequence to split.
-PARALLELISM_FAMILIES: dict[str, list[str]] = {
-    "pipeline_schedule": ["pp2_gpipe", "pp2_1f1b"],
-    "context_parallel": ["sp2_ring", "sp2_ulysses"],
-    "moe_dispatch": ["ep2_moe_dense", "ep2_moe_capacity"],
-    # the reshard cost behind train/loop.py's grad-accum x dp warning:
-    # same model/mesh/grad_accum, batch 16 keeps micro-batches divisible
-    # by dp=4, batch 20 forces the per-micro-step reshard — per-TOKEN
-    # throughput is the comparison (batches differ by construction)
-    "grad_accum_reshard": ["ga2_divisible_b16", "ga2_reshard_b20"],
-}
+# Parallelism-family benchmark matrix (VERDICT r3 missing #4): families
+# live in the library (single source of truth shared with the `reports`
+# CLI).  Model is the small train-stage geometry so the simulated mesh
+# measures schedules, not host-core matmul throughput.  Sequence length
+# 128 gives the sp families a real sequence to split.
+from dlbb_tpu.stats.parallelism_report import (  # noqa: E402
+    DEFAULT_FAMILIES as PARALLELISM_FAMILIES,
+)
 
 _PARALLELISM_CONFIGS: dict[str, tuple[dict, dict, dict]] = {
     # name: (model overrides, parallelism block, training overrides)
@@ -612,12 +605,9 @@ def stage_stats() -> None:
         log(f"  variants {size}: {w['winner']} ({vs})")
     from dlbb_tpu.stats.variants_report import write_variants3d_report
 
-    rows3d = write_variants3d_report(
-        STATS / "variants3d",
-        STATS / "3d" / "xla_tpu"
-        / "benchmark_statistics_3d_xla_tpu_standard.csv",
-        STATS / "variants3d",
-    )
+    # base-corpus CSV + out dir come from the library defaults, shared
+    # with the `reports` CLI
+    rows3d = write_variants3d_report(STATS / "variants3d")
     if rows3d:
         log(f"  variants3d: {len(rows3d)} joined configs "
             f"(stats/variants3d/VARIANTS3D.md)")

@@ -535,3 +535,11 @@ def test_variants3d_report(tmp_path):
     assert r["winner_speedup_vs_default"] == 2.0
     assert (tmp_path / "out" / "VARIANTS3D.md").exists()
     assert (tmp_path / "out" / "variants3d_comparison.csv").exists()
+
+    # a scanned dir named xla_tpu would shadow the baseline — rejected
+    import pytest
+
+    std_csv(tmp_path / "v3d" / "xla_tpu" / "x_standard.csv",
+            "xla_tpu", [(8, 1, 2048, 2048, 3.0)])
+    with pytest.raises(ValueError, match="shadow"):
+        write_variants3d_report(tmp_path / "v3d", base, tmp_path / "out")

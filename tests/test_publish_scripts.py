@@ -190,3 +190,30 @@ def test_train_unknown_only_suffix_rejected(monkeypatch, tmp_path):
 
     with pytest.raises(SystemExit, match="unknown config"):
         mod.main()
+
+
+def test_parallelism_stage_families_consistent():
+    """Every family member has a runnable config, every config belongs to
+    a family, and each config's mesh product fits the 8-device stage."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "publish_baselines", REPO / "scripts" / "publish_baselines.py"
+    )
+    # the module force-selects the simulated backend at import; that is
+    # already this test session's backend, so importing is safe
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    members = {m for ms in mod.PARALLELISM_FAMILIES.values() for m in ms}
+    configs = set(mod._PARALLELISM_CONFIGS)
+    assert members == configs
+    for name, (_, par, _) in mod._PARALLELISM_CONFIGS.items():
+        product = 1
+        for v in par.values():
+            if isinstance(v, int) and v > 0:
+                product *= v
+        # num_microbatches is a schedule knob, not a mesh axis
+        if "num_microbatches" in par:
+            product //= par["num_microbatches"]
+        assert product <= 8, (name, par)
