@@ -293,6 +293,21 @@ def _dispatch(args) -> int:
         else:
             print(f"parallelism: no train_*.json under {par_dir} — "
                   "skipped")
+        from dlbb_tpu.stats.northstar import (
+            default_stats_1d_csv,
+            write_northstar_report,
+        )
+
+        ns = write_northstar_report(
+            default_stats_1d_csv(stats_root), stats_root / "northstar",
+        )
+        if ns:
+            produced += 1
+            print(f"northstar: {sum(ns.values())} size rows across "
+                  f"{list(ns)} -> {stats_root / 'northstar' / 'NORTHSTAR.md'}")
+        else:
+            print(f"northstar: no north-star rows in "
+                  f"{default_stats_1d_csv(stats_root)} — skipped")
         if produced == 0:
             print("error: nothing to report — check --stats/--results "
                   "point at the committed trees")

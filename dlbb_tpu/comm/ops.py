@@ -41,7 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.comm.mesh import DEFAULT_AXIS, mesh_num_ranks
+from dlbb_tpu.comm.mesh import mesh_num_ranks
 
 
 @dataclass(frozen=True)

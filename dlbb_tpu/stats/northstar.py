@@ -1,0 +1,133 @@
+"""The north-star curve, rendered as one committed table.
+
+The driver metric (BASELINE.json) is "all-reduce bus bandwidth (GB/s) +
+p50 latency vs msg size, 1 KB–1 GB, fp32+bf16".  The measurements live
+scattered across the per-config 1D stats; this module collapses them into
+a single per-op table — rows = size labels in payload order, one column
+group per (ranks, dtype) — so the literal metric is readable in one
+place (``stats/northstar/NORTHSTAR.md`` + per-op CSVs).
+
+Cells show ``median_time_us / bandwidth_gbps`` from the same stats rows
+the comparison report consumes (median = the metric's p50; bandwidth =
+the reference's uniform formula, ``stats1d.calculate_bandwidth``).
+Absent cells are honest absences (memory-capped configs — the committed
+skip log in the publisher is their artifact).
+"""
+
+from __future__ import annotations
+
+import csv
+from pathlib import Path
+from typing import Any
+
+NORTH_STAR_OPS = ("allreduce", "allgather", "broadcast")
+
+_DTYPE_SHORT = {"bfloat16": "bf16", "float32": "fp32", "float16": "fp16"}
+
+
+def _read_stats_csv(csv_path: Path) -> list[dict[str, Any]]:
+    with Path(csv_path).open() as f:
+        return list(csv.DictReader(f))
+
+
+def build_curve(
+    rows: list[dict[str, Any]], operation: str
+) -> tuple[list[str], list[dict[str, Any]], list[tuple[int, str]]]:
+    """(size labels in payload order, table rows, (ranks, dtype) column
+    keys) for one op."""
+    from dlbb_tpu.stats.variants_report import _parse_size_label
+
+    sub = [r for r in rows if r["operation"] == operation]
+    sizes = sorted(
+        {r["data_size_name"] for r in sub},
+        key=lambda s: (_parse_size_label(s), s),
+    )
+    cols = sorted({
+        (int(r["num_ranks"]), r.get("dtype") or "bfloat16") for r in sub
+    })
+    cells = {
+        (r["data_size_name"], int(r["num_ranks"]),
+         r.get("dtype") or "bfloat16"): r
+        for r in sub
+    }
+    table = []
+    for size in sizes:
+        row: dict[str, Any] = {"size": size}
+        for ranks, dtype in cols:
+            r = cells.get((size, ranks, dtype))
+            key = f"{ranks}r/{_DTYPE_SHORT.get(dtype, dtype)}"
+            if r is None:
+                row[key] = None
+                continue
+            med = float(r["median_time_us"])
+            bw = r.get("bandwidth_gbps")
+            bw_s = f"{float(bw):.3g}" if bw not in (None, "") else "?"
+            row[key] = f"{med:,.0f}us / {bw_s}GB/s"
+        table.append(row)
+    col_names = [f"{n}r/{_DTYPE_SHORT.get(d, d)}" for n, d in cols]
+    return sizes, table, col_names  # type: ignore[return-value]
+
+
+def default_stats_1d_csv(stats_root: Path) -> Path:
+    """The consolidated 1D stats CSV under a stats tree — single source of
+    the path for the publisher stage and the ``reports`` CLI."""
+    return Path(stats_root) / "1d" / "xla_tpu" / "benchmark_statistics.csv"
+
+
+def write_northstar_report(
+    stats_1d_csv: Path,
+    out_dir: Path,
+    operations: tuple[str, ...] = NORTH_STAR_OPS,
+) -> dict[str, int]:
+    """Emit ``NORTHSTAR.md`` + per-op ``northstar_<op>.csv``; returns
+    {op: row count}.  No-op (returns {}, writes nothing) when the stats
+    CSV is absent or holds no north-star op rows — a partial regeneration
+    must never clobber the committed report with an empty shell."""
+    stats_1d_csv = Path(stats_1d_csv)
+    if not stats_1d_csv.exists():
+        return {}
+    rows = _read_stats_csv(stats_1d_csv)
+    curves = {}
+    for op in operations:
+        sizes, table, col_names = build_curve(rows, op)
+        if table:
+            curves[op] = (table, col_names)
+    if not curves:
+        return {}
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    from dlbb_tpu.stats.compare import md_table
+
+    md = [
+        "# North-star curve — p50 latency / bus bandwidth vs message size",
+        "",
+        "The driver metric (`BASELINE.json`): all-reduce bus bandwidth + "
+        "p50 latency vs msg size, 1 KB–1 GB, fp32+bf16 — plus the "
+        "allgather/broadcast companions of configs[1].  One column per "
+        "(rank count, dtype); cells are `median_us / bandwidth_GB/s` from "
+        "the committed per-config stats (`stats/1d/xla_tpu`).  Size "
+        "labels are the reference's (nominal — byte counts in the "
+        "artifacts); blank cells are memory-capped configs whose skip is "
+        "logged by the publisher.  All values are the CPU-simulated mesh "
+        "(host-RAM collectives, not ICI — see COMPARISON.md caveats); "
+        "note bf16 is software-emulated on the host CPU, which is why "
+        "fp32 columns often beat bf16 here — on TPU hardware that "
+        "relationship inverts (bf16 is the native MXU type).",
+        "",
+    ]
+    counts: dict[str, int] = {}
+    for op, (table, col_names) in curves.items():
+        counts[op] = len(table)
+        columns = ["size", *col_names]
+        with (out_dir / f"northstar_{op}.csv").open(
+            "w", newline=""
+        ) as f:
+            w = csv.DictWriter(f, fieldnames=columns)
+            w.writeheader()
+            w.writerows(table)
+        md += [f"## {op}", ""]
+        md += md_table(table, columns)
+        md.append("")
+    (out_dir / "NORTHSTAR.md").write_text("\n".join(md))
+    return counts

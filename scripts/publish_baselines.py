@@ -611,6 +611,17 @@ def stage_stats() -> None:
     if rows3d:
         log(f"  variants3d: {len(rows3d)} joined configs "
             f"(stats/variants3d/VARIANTS3D.md)")
+    from dlbb_tpu.stats.northstar import (
+        default_stats_1d_csv,
+        write_northstar_report,
+    )
+
+    ns = write_northstar_report(
+        default_stats_1d_csv(STATS), STATS / "northstar",
+    )
+    if ns:
+        log(f"  northstar: {sum(ns.values())} size rows across "
+            f"{list(ns)} (stats/northstar/NORTHSTAR.md)")
 
 
 def stage_compare() -> None:

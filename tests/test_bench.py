@@ -543,3 +543,60 @@ def test_variants3d_report(tmp_path):
             "xla_tpu", [(8, 1, 2048, 2048, 3.0)])
     with pytest.raises(ValueError, match="shadow"):
         write_variants3d_report(tmp_path / "v3d", base, tmp_path / "out")
+
+
+def test_northstar_report(tmp_path):
+    """The driver-metric table: one row per size label (payload order),
+    one column per (ranks, dtype), median/bandwidth cells, honest blanks
+    for unmeasured combinations."""
+    import csv as _csv
+
+    from dlbb_tpu.stats.northstar import write_northstar_report
+
+    cols = ["mpi_implementation", "operation", "num_ranks",
+            "data_size_name", "num_elements", "median_time_us",
+            "bandwidth_gbps", "dtype"]
+    stats_csv = tmp_path / "benchmark_statistics.csv"
+    with stats_csv.open("w", newline="") as f:
+        w = _csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        for ranks, size, n, dtype, med, bw in (
+            (2, "1KB", 256, "bfloat16", 100.0, 0.01),
+            (2, "1KB", 256, "float32", 80.0, 0.02),
+            (2, "16MB", 4194304, "bfloat16", 9000.0, 1.5),
+            # 16MB fp32 unmeasured -> blank cell
+        ):
+            w.writerow({"mpi_implementation": "xla_tpu",
+                        "operation": "allreduce", "num_ranks": ranks,
+                        "data_size_name": size, "num_elements": n,
+                        "median_time_us": med, "bandwidth_gbps": bw,
+                        "dtype": dtype})
+    counts = write_northstar_report(stats_csv, tmp_path / "out",
+                                    operations=("allreduce",))
+    assert counts == {"allreduce": 2}
+    with (tmp_path / "out" / "northstar_allreduce.csv").open() as f:
+        rows = list(_csv.DictReader(f))
+    assert [r["size"] for r in rows] == ["1KB", "16MB"]  # payload order
+    assert rows[0]["2r/fp32"].startswith("80us")
+    assert rows[1]["2r/fp32"] == ""  # honest blank
+    md = (tmp_path / "out" / "NORTHSTAR.md").read_text()
+    assert "allreduce" in md and "p50" in md
+
+    # absent stats CSV -> no-op, nothing written
+    assert write_northstar_report(tmp_path / "missing.csv",
+                                  tmp_path / "out2") == {}
+    assert not (tmp_path / "out2").exists()
+
+    # stats CSV without any north-star op rows -> no-op too: a partial
+    # regeneration must not clobber the committed report with a shell
+    empty_csv = tmp_path / "empty_stats.csv"
+    with empty_csv.open("w", newline="") as f:
+        w = _csv.DictWriter(f, fieldnames=cols)
+        w.writeheader()
+        w.writerow({"mpi_implementation": "xla_tpu",
+                    "operation": "reducescatter", "num_ranks": 2,
+                    "data_size_name": "1KB", "num_elements": 256,
+                    "median_time_us": 1.0, "bandwidth_gbps": 0.1,
+                    "dtype": "bfloat16"})
+    assert write_northstar_report(empty_csv, tmp_path / "out3") == {}
+    assert not (tmp_path / "out3").exists()
