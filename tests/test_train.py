@@ -216,3 +216,35 @@ def test_parallelism_report(tmp_path):
     assert by["sp2_ring"]["step_time_mean_s"] is None  # listed, not dropped
     assert (tmp_path / "out" / "PARALLELISM.md").exists()
     assert (tmp_path / "out" / "parallelism_comparison.csv").exists()
+
+
+def test_zero3_compiles_param_allgather_pattern(devices):
+    """ZeRO-3/FSDP is DECLARED (dp-sharded params); the compiled step must
+    contain all-gather collectives (params gathered on use) that plain DDP
+    (replicated params, dp=grad-psum only) does not need."""
+    import re
+
+    import jax.numpy as jnp
+
+    from dlbb_tpu.parallel.plan import build_parallelism_mesh
+    from dlbb_tpu.train.loop import make_train_step
+
+    cfg = TINY.with_(attention="simplified")
+    mesh = build_parallelism_mesh(8, 1, 1, 1, 1)
+    x = jnp.zeros((8, 8, cfg.hidden_size))
+
+    def hlo_for(stage):
+        params = init_params(cfg, jax.random.key(0))
+        jit_step, state = make_train_step(
+            cfg, mesh, optax.sgd(1e-3), params, zero_stage=stage
+        )
+        return jit_step.lower(state, x, x).compile().as_text()
+
+    hlo3 = hlo_for(3)
+    hlo0 = hlo_for(0)
+    assert len(re.findall(r"\ball-gather", hlo3)) >= 1, \
+        "ZeRO-3 step compiled without param all-gathers"
+    # DDP still all-reduces gradients over dp, but has no param gathers
+    assert len(re.findall(r"\ball-reduce", hlo0)) >= 1
+    assert len(re.findall(r"\ball-gather", hlo3)) > \
+        len(re.findall(r"\ball-gather", hlo0))
