@@ -724,9 +724,15 @@ def stage_baseline() -> None:
                 entry["simulated"] = True
             e2e[r["experiment"]["name"]] = entry
         published["e2e_corpus"] = e2e
-    vr = STATS / "variants" / "variants_comparison.csv"
-    if vr.exists():
-        published["variants_report"] = str(vr.relative_to(REPO))
+    for key, rel in (
+        ("variants_report", STATS / "variants" / "variants_comparison.csv"),
+        ("northstar_report", STATS / "northstar" / "NORTHSTAR.md"),
+        ("variants3d_report", STATS / "variants3d" / "VARIANTS3D.md"),
+        ("parallelism_report", STATS / "parallelism" / "PARALLELISM.md"),
+        ("comparison_report", STATS / "compare" / "COMPARISON.md"),
+    ):
+        if rel.exists():
+            published[key] = str(rel.relative_to(REPO))
     mc = RESULTS / "multichip" / "bench_allreduce_multichip_8ranks.json"
     if mc.exists():
         published["multichip_headline"] = json.loads(mc.read_text())

@@ -270,8 +270,13 @@ def test_tp_forward_compiles_megatron_allreduce_pattern(devices):
 
     hlo_tp = compiled_hlo(4)
     hlo_single = compiled_hlo(1)
-    assert len(re.findall(r"\ball-reduce", hlo_tp)) >= 2, \
-        "TP forward compiled without the Megatron all-reduces"
     assert "while" in hlo_tp  # layers execute under lax.scan
+    # the all-reduces must live INSIDE the scanned layer body (the while
+    # loop's called computations), not hoisted to top level — extract the
+    # non-entry computations and look there
+    body_text = hlo_tp.split("ENTRY")[0]
+    assert len(re.findall(r"\ball-reduce", body_text)) >= 2, \
+        "TP forward compiled without the Megatron all-reduces in the " \
+        "scanned layer body"
     assert "all-reduce" not in hlo_single, \
         "single-device forward must need no collectives"
