@@ -136,9 +136,9 @@ def compare_1d(
     out = []
     for r in own:
         # own-side rows are keyed by (op, size, ranks, dtype): the corpus
-        # carries the north-star curve in both bf16 and fp32, each joined
-        # against the same reference best (the reference measured one
-        # dtype — nominal fp16 payloads — per config)
+        # carries bf16 (TPU-native) + fp32 (north-star companion) + fp16
+        # (the reference's own dtype — parity slice), each joined against
+        # the same reference best
         key = (r["operation"], r["data_size_name"], r["num_ranks"])
         ref = ref_best.get(key)
         if ref is None:
@@ -364,8 +364,8 @@ def _write_csv(rows: list[dict], columns: list[str], path: Path) -> None:
 
 def _distinct_configs(rows: list[dict]) -> int:
     """Distinct reference configs covered — dtype is an own-side axis, so
-    a (op, size, ranks) point measured in both bf16 and fp32 is ONE
-    config with two rows."""
+    a (op, size, ranks) point measured in several dtypes (bf16/fp16/fp32)
+    is ONE config with one row per dtype."""
     keys = set()
     for r in rows:
         if "data_size_name" in r:
@@ -431,9 +431,13 @@ def write_comparison(
         "reference corpus ran real MPI/oneCCL ranks on a 56-core node; "
         "this repo's corpus runs the CPU-simulated 8-device mesh on this "
         "image's single core (host-RAM collectives, not ICI).  The join "
-        "covers the rank counts both corpora measured.  E2E rows are "
-        "real-TPU-chip numbers vs the re-measured reference-stack "
-        "torch-CPU baseline.",
+        "covers the rank counts both corpora measured.  `xla_dtype` "
+        "float16 rows use the reference's own payload dtype (the closest "
+        "apples-to-apples rows); bf16 is the TPU-native dtype and fp32 "
+        "the north-star companion — all three at identical per-config "
+        "byte counts.  E2E "
+        "rows are real-TPU-chip numbers vs the re-measured "
+        "reference-stack torch-CPU baseline.",
         "",
         "## Summary",
         "",

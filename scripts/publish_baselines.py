@@ -191,6 +191,38 @@ TAIL_SIZES = tuple(
 )
 
 
+def stage_1dfp16() -> None:
+    """fp16 parity slice: the reference's 1D corpus is measured on fp16
+    payloads (``collectives/1d/openmpi.py:247-248``).  Byte counts per
+    config already matched (bf16 and fp16 are both 2 B/element over the
+    same element counts); what this slice adds is DTYPE identity — the
+    same float16 numeric type the reference timed — making these the
+    closest apples-to-apples rows of the comparison join.  All 8 ops,
+    canonical sizes, ranks {2,4,8} (16 via the 1dfp16_16 stage)."""
+    log("1D fp16 parity slice (all 8 ops, canonical sizes)")
+    run_sweep(Sweep1D(
+        dtype="float16",
+        output_dir=str(RESULTS / "1d" / "xla_tpu"),
+        max_config_seconds=15.0,
+        max_global_bytes=8 * GIB,
+        resume=RESUME,
+    ))
+
+
+def stage_1dfp16_16() -> None:
+    if not _require_devices(16, "1dfp16_16"):
+        return
+    log("1D fp16 parity slice @ 16 ranks")
+    run_sweep(Sweep1D(
+        rank_counts=(16,),
+        dtype="float16",
+        output_dir=str(RESULTS / "1d" / "xla_tpu"),
+        max_config_seconds=10.0,
+        max_global_bytes=8 * GIB,
+        resume=RESUME,
+    ))
+
+
 def stage_1dtail() -> None:
     log("1D big-payload tail (256MB/1GB, bf16+fp32, ranks 2/4/8)")
     for dtype in ("bfloat16", "float32"):
@@ -772,6 +804,8 @@ STAGES = {
     "1d": stage_1d,
     "1dfp32": stage_1dfp32,
     "1dfp32_16": stage_1dfp32_16,
+    "1dfp16": stage_1dfp16,
+    "1dfp16_16": stage_1dfp16_16,
     "1dtail": stage_1dtail,
     "1dtail_16": stage_1dtail_16,
     "3d": stage_3d,
