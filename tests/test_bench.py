@@ -425,6 +425,46 @@ def test_bench_allreduce_multichip_schema(devices):
     )
 
 
+def test_bench_probe_backend_outcomes(monkeypatch):
+    """The device-init probe runs out-of-process so a down-but-not-refusing
+    tunnel (jax.devices() hanging in-process) cannot hang the driver's
+    bench run: timeout and nonzero exit both resolve to None (-> the
+    degraded simulated-mesh fallback), success parses the device count."""
+    import subprocess
+    import types
+
+    import bench
+
+    def fake(result):
+        def run(cmd, capture_output=True, text=True, timeout=None):
+            if result == "timeout":
+                raise subprocess.TimeoutExpired(cmd, timeout)
+            if result == "fail":
+                return types.SimpleNamespace(
+                    returncode=1, stdout="", stderr="backend init error\n"
+                )
+            if result == "empty":
+                return types.SimpleNamespace(
+                    returncode=0, stdout="", stderr=""
+                )
+            return types.SimpleNamespace(
+                returncode=0, stdout="warning noise\n8\n", stderr=""
+            )
+        return run
+
+    monkeypatch.setattr(subprocess, "run", fake("timeout"))
+    n, reason = bench.probe_backend(timeout_s=1.0)
+    assert n is None and "timed out" in reason
+    monkeypatch.setattr(subprocess, "run", fake("fail"))
+    n, reason = bench.probe_backend()
+    assert n is None and "exited 1" in reason
+    monkeypatch.setattr(subprocess, "run", fake("empty"))
+    n, reason = bench.probe_backend()
+    assert n is None and "no device count" in reason
+    monkeypatch.setattr(subprocess, "run", fake("ok"))
+    assert bench.probe_backend() == (8, None)
+
+
 def test_variants_report_picks_winner(tmp_path):
     """The tuning-comparison capstone: per-size join over variant stats
     CSVs, winner + speedup-vs-default computed, fixed-shape variants with
