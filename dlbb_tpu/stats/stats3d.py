@@ -44,12 +44,22 @@ _SORT_KEY = lambda r: (  # noqa: E731
 
 
 def calculate_statistics_3d(timings_2d: list[list[float]]) -> dict[str, float]:
+    """ms-scale aggregate stats (reference ``collectives/3d/stats.py:32-49``).
+
+    Hot loop of the 3D pipeline (hundreds of files per corpus pass) —
+    delegates to ``utils.metrics.summarize``, the ONE
+    native-C++-with-numpy-fallback summary dispatch (numerics asserted
+    identical in ``tests/test_native.py``), and maps its seconds-scale
+    fields to the reference's ms keys."""
+    from dlbb_tpu.utils.metrics import summarize
+
     flat = np.asarray(timings_2d, dtype=np.float64).ravel()
+    s = summarize(flat)
     return {
-        "mean_time_ms": float(flat.mean() * 1e3),
-        "median_time_ms": float(np.median(flat) * 1e3),
-        "min_time_ms": float(flat.min() * 1e3),
-        "max_time_ms": float(flat.max() * 1e3),
+        "mean_time_ms": s["mean"] * 1e3,
+        "median_time_ms": s["median"] * 1e3,
+        "min_time_ms": s["min"] * 1e3,
+        "max_time_ms": s["max"] * 1e3,
     }
 
 

@@ -91,3 +91,32 @@ def test_native_disabled_falls_back(monkeypatch):
     assert out["mean"] == 2.0
     # restore loader state for later tests
     monkeypatch.setattr(native, "_tried", False)
+
+
+def test_stats3d_native_matches_numpy(monkeypatch):
+    """calculate_statistics_3d goes through the shared summarize dispatch;
+    the native kernel (when buildable) and the forced numpy fallback must
+    produce identical ms-scale numbers, and the key mapping must be
+    field-correct either way."""
+    import numpy as np
+
+    from dlbb_tpu import native
+    from dlbb_tpu.stats.stats3d import calculate_statistics_3d
+
+    rng = np.random.default_rng(0)
+    timings = rng.uniform(1e-4, 5e-3, size=(4, 25)).tolist()
+    flat = np.asarray(timings).ravel()
+    want = {
+        "mean_time_ms": float(flat.mean() * 1e3),
+        "median_time_ms": float(np.median(flat) * 1e3),
+        "min_time_ms": float(flat.min() * 1e3),
+        "max_time_ms": float(flat.max() * 1e3),
+    }
+
+    got_default = calculate_statistics_3d(timings)  # native if buildable
+    # force the numpy fallback regardless of toolchain
+    monkeypatch.setattr(native, "summarize_native", lambda _: None)
+    got_numpy = calculate_statistics_3d(timings)
+    for k, v in want.items():
+        np.testing.assert_allclose(got_default[k], v, rtol=1e-12, atol=0)
+        np.testing.assert_allclose(got_numpy[k], v, rtol=1e-12, atol=0)
