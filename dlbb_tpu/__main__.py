@@ -1,0 +1,8 @@
+"""``python -m dlbb_tpu`` — same CLI as ``python -m dlbb_tpu.cli``."""
+
+import sys
+
+from dlbb_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())
