@@ -100,7 +100,7 @@ def _rows_3d(results_dir: Path, backend: str) -> list[dict[str, Any]]:
             shape = data["tensor_shape"]
             rows.append({
                 "backend": backend,
-                "measured_backend": data.get("system_info", {}).get(
+                "measured_backend": (data.get("system_info") or {}).get(
                     "backend"),
                 "operation": data["operation"],
                 "num_ranks": data["num_ranks"],
@@ -242,7 +242,7 @@ def _e2e_rows(repo_root: Path) -> list[dict[str, Any]]:
                     continue
             by_name[name] = r
         for name, r in by_name.items():
-            sysinfo = r.get("system_info", {})
+            sysinfo = r.get("system_info") or {}
             device = (
                 f"{sysinfo.get('device_kind', '?')} x "
                 f"{sysinfo.get('num_devices', '?')}"
@@ -434,8 +434,12 @@ def write_comparison(
         "covers the rank counts both corpora measured.  `xla_dtype` "
         "float16 rows use the reference's own payload dtype (the closest "
         "apples-to-apples rows); bf16 is the TPU-native dtype and fp32 "
-        "the north-star companion — all three at identical per-config "
-        "byte counts.  E2E "
+        "the north-star companion.  The three dtypes share per-config "
+        "*element counts* with the reference labels: fp16/bf16 rows "
+        "therefore byte-match the fp16-measured reference, while fp32 "
+        "rows move 2x the reference's bytes at the same size label "
+        "(4 B/element) — their speedup/raw_verdict values compare "
+        "doubled payload volume.  E2E "
         "rows are real-TPU-chip numbers vs the re-measured "
         "reference-stack torch-CPU baseline.",
         "",

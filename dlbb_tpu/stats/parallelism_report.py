@@ -72,9 +72,16 @@ def collect_family_rows(
         # batches (same ordering either way), but e.g. the grad-accum
         # reshard pair intentionally differs in batch size — per-token
         # throughput is the comparable metric
-        best: Optional[float] = (
-            max(r["tokens_per_second"] for r in present.values())
+        # single winner by identity (first member in declared order at
+        # the max) — float-equality ties would otherwise mark several
+        # rows winner and render slowdown_vs_winner ambiguously
+        best_member: Optional[str] = (
+            max(present, key=lambda m: present[m]["tokens_per_second"])
             if present else None
+        )
+        best: Optional[float] = (
+            present[best_member]["tokens_per_second"]
+            if best_member is not None else None
         )
         for m in members:
             r = present.get(m)
@@ -96,7 +103,7 @@ def collect_family_rows(
                 ) or "single",
                 "step_time_mean_s": round(r["step_time"]["mean"], 6),
                 "tokens_per_second": round(tps, 1),
-                "winner": tps == best,
+                "winner": m == best_member,
                 "slowdown_vs_winner": round(best / tps, 4),
             })
     return rows

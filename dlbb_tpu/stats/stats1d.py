@@ -175,7 +175,7 @@ def process_file(
         # measured backend ("cpu" = simulated mesh) — consumed by the
         # comparison's not_comparable(simulated) verdict; reference
         # artifacts record no system_info and get None
-        "backend": data.get("system_info", {}).get("backend"),
+        "backend": (data.get("system_info") or {}).get("backend"),
     }
     if "percentile_caveat" in data:
         out["percentile_caveat"] = data["percentile_caveat"]

@@ -745,7 +745,7 @@ def stage_baseline() -> None:
             # publish the MEASURED backend (system_info), not the label
             # run_e2e stamps on every artifact — the simulated-mesh rows
             # (e.g. 13B_tp8_forward) must not read as chip numbers
-            sysinfo = r.get("system_info", {})
+            sysinfo = r.get("system_info") or {}
             entry = {
                 "tokens_per_second": round(r["tokens_per_second"], 1),
                 "achieved_tflops_per_second": round(
@@ -790,7 +790,7 @@ def stage_baseline() -> None:
             if r.get("achieved_tflops_per_second_incl_recompute") is not None:
                 entry["achieved_tflops_per_second_incl_recompute"] = (
                     r["achieved_tflops_per_second_incl_recompute"])
-            sysinfo = r.get("system_info", {})
+            sysinfo = r.get("system_info") or {}
             if sysinfo.get("backend") == "cpu":
                 entry["simulated"] = True
             ladder[name] = entry

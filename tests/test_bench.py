@@ -228,6 +228,26 @@ def test_stats_1d_granularity_marker(tmp_path):
     assert "chunk means" in stats["percentile_caveat"]
 
 
+def test_stats_1d_null_system_info(tmp_path):
+    """An artifact with an explicit ``"system_info": null`` (as opposed to
+    a missing key) must process cleanly with ``backend`` = None — the
+    ``.get`` default only covers the missing-key case."""
+    artifact = {
+        "implementation": "xla_test", "operation": "allreduce",
+        "num_ranks": 4, "data_size_name": "1KB", "num_elements": 256,
+        "dtype": "bfloat16", "warmup_iterations": 1,
+        "measurement_iterations": 3, "timings": [[1e-4, 1.2e-4, 0.9e-4]],
+        "system_info": None,
+    }
+    d = tmp_path / "r"
+    d.mkdir()
+    (d / "xla_test_allreduce_ranks4_1KB.json").write_text(
+        json.dumps(artifact))
+    results = process_1d_results(d, tmp_path / "s", verbose=False)
+    assert len(results) == 1
+    assert results[0]["backend"] is None
+
+
 def test_stats_3d_granularity_marker(tmp_path):
     """3D: the standard CSV header is the reference contract (unchanged);
     the granularity marker rides the transposed CSV's metadata block."""
