@@ -217,3 +217,41 @@ def test_parallelism_stage_families_consistent():
         if "num_microbatches" in par:
             product //= par["num_microbatches"]
         assert product <= 8, (name, par)
+
+
+def test_reports_regeneration_is_byte_stable(tmp_path):
+    """``reports`` over the committed corpus must be a byte-level no-op.
+
+    The derived tables (VARIANTS.md, VARIANTS3D.md, PARALLELISM.md,
+    NORTHSTAR.md and their CSVs) are committed artifacts; the native-core
+    stats path claims byte-stable regeneration — this pins it.  The whole
+    ``stats/`` tree is copied aside, regenerated in place, and every file
+    compared back byte-for-byte (inputs trivially identical, derived
+    outputs must round-trip)."""
+    import filecmp
+    import shutil
+
+    from dlbb_tpu.cli import main as cli_main
+
+    stats_copy = tmp_path / "stats"
+    par_copy = tmp_path / "results" / "parallelism"
+    shutil.copytree(REPO / "stats", stats_copy)
+    shutil.copytree(REPO / "results" / "parallelism", par_copy)
+
+    rc = cli_main([
+        "reports",
+        "--stats", str(stats_copy),
+        "--results", str(tmp_path / "results"),
+    ])
+    assert rc == 0
+
+    mismatches = []
+    for f in sorted(stats_copy.rglob("*")):
+        if not f.is_file():
+            continue
+        committed = REPO / "stats" / f.relative_to(stats_copy)
+        if not committed.is_file():
+            mismatches.append(f"{f.relative_to(stats_copy)}: new file")
+        elif not filecmp.cmp(f, committed, shallow=False):
+            mismatches.append(f"{f.relative_to(stats_copy)}: differs")
+    assert not mismatches, mismatches
