@@ -1,0 +1,97 @@
+"""Real 2-process worker for the non-mock multi-host test.
+
+Launched by ``tests/test_multihost.py::test_real_two_process_sweep`` as
+``python tests/multihost_worker.py <process_id> <coordinator_port> <out_dir>``.
+Each process initialises ``jax.distributed`` against a local TCP
+coordinator (CPU backend, gloo cross-process collectives, 2 simulated
+devices per process -> one global 4-device mesh) and drives a tiny real
+``Sweep1D`` through the code paths the mocked tests can only fake:
+
+- ``_gather_timings``: process_count == 2 -> the host-side allgather
+  branch; the written artifact must carry one timing row per host.
+- ``_resume_exists``: the collective resume decision; exercised with the
+  hosts *disagreeing* (each passes a different path, only process 0's
+  exists) -> must return False on BOTH hosts, and with both agreeing ->
+  must return True on both.
+
+NOT imported by pytest collection (no ``test_`` prefix in module-level
+names); runs standalone only.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+from pathlib import Path
+
+# process-start env: must precede the jax import
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # the axon sitecustomize
+# force-registers the TPU plugin; only the config update selects CPU
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def main(process_id: int, port: int, out_dir: str) -> None:
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=process_id,
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.local_device_count() == 2
+    assert len(jax.devices()) == 4
+
+    from dlbb_tpu.bench.runner import (
+        Sweep1D,
+        _resume_exists,
+        run_sweep,
+    )
+
+    sweep = Sweep1D(
+        operations=("allreduce",),
+        data_sizes=(("1KB", 256),),
+        rank_counts=(4,),
+        warmup_iterations=1,
+        measurement_iterations=3,
+        timing_mode="per_iter",
+        output_dir=out_dir,
+    )
+    written = run_sweep(sweep, verbose=process_id == 0)
+    assert len(written) == 1, written
+    artifact = json.loads(Path(written[0]).read_text())
+    # the multi-host gather branch: one timing row per host
+    assert len(artifact["timings"]) == 2, len(artifact["timings"])
+    assert len(artifact["timings"][0]) == 3
+    assert artifact["num_ranks"] == 4
+
+    # resume pass: shared disk, both hosts hold the artifact -> both skip
+    resumed = run_sweep(
+        dataclasses.replace(sweep, resume=True), verbose=False
+    )
+    assert resumed == written, (resumed, written)
+
+    # disagreeing hosts: only process 0's probe path exists -> the
+    # collective decision must be False on BOTH (a per-host decision here
+    # is exactly the pod-hang bug the docstring warns about)
+    mine = Path(out_dir) / f"probe_proc{process_id}.marker"
+    if process_id == 0:
+        mine.write_text("present")
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("probe_written")
+    disagree = _resume_exists(mine)
+    assert disagree is False, disagree
+
+    # agreeing hosts: the shared artifact exists everywhere -> True
+    agree = _resume_exists(Path(written[0]))
+    assert agree is True, agree
+
+    print(f"WORKER-OK proc={process_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), sys.argv[3])

@@ -1,11 +1,15 @@
-"""Multi-host code paths, pinned with mocks.
+"""Multi-host code paths: mocked branch pins + one REAL 2-process run.
 
 A TPU pod isn't available in CI (same constraint as the reference, which
 tests multi-node by running N ranks on localhost under mpirun — SURVEY §4),
-but the multi-process branches must not be untestable-by-accident: these
+but the multi-process branches must not be untestable-by-accident: most
 tests monkeypatch ``jax.process_count`` / ``jax.process_index`` /
 ``multihost_utils.process_allgather`` / ``jax.distributed.initialize`` to
-drive the exact code the pod launcher would.
+drive the exact code the pod launcher would, and
+``test_real_two_process_sweep`` runs the same premise as the reference's
+localhost mpirun — two genuine ``jax.distributed`` processes over a TCP
+coordinator (CPU backend, gloo) driving a real ``Sweep1D`` through the
+gather and collective-resume branches (worker: ``multihost_worker.py``).
 """
 
 import jax
@@ -136,3 +140,53 @@ def test_initialize_distributed_default_noop(monkeypatch):
     assert rec.calls == []
     assert ctx.num_processes == 1
     assert ctx.is_coordinator
+
+
+def test_real_two_process_sweep(tmp_path):
+    """NON-MOCK: two real OS processes under ``jax.distributed`` (local TCP
+    coordinator, CPU backend, gloo collectives) drive a tiny ``Sweep1D``
+    end-to-end, exercising the ``_gather_timings`` allgather branch (the
+    artifact carries one timing row per host) and the ``_resume_exists``
+    collective decision with both agreeing AND disagreeing hosts — the
+    branches every other test in this file can only mock.  Runs in fresh
+    subprocesses because this pytest process already owns a
+    single-process backend."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    worker = repo / "tests" / "multihost_worker.py"
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = f"{repo}:{env.get('PYTHONPATH', '')}"
+    # the worker sets its own XLA_FLAGS/JAX_PLATFORMS before importing jax
+    env.pop("XLA_FLAGS", None)
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), str(port),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker exited {p.returncode}:\n{out}"
+    assert "WORKER-OK proc=0" in outs[0]
+    assert "WORKER-OK proc=1" in outs[1]
