@@ -35,31 +35,45 @@ REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(Path(__file__).resolve().parent))  # _publish_common
 
-# (name_suffix, training overrides, model overrides)
-CONFIGS: tuple[tuple[str, dict, dict], ...] = (
+# (name_suffix, training overrides, model overrides, input overrides)
+# input overrides {} = the canonical BATCH_SIZE/SEQ_LEN shape.
+_DOTS_ADAM = {"optimizer": "adam", "moments_dtype": "bfloat16"}
+_DOTS_MODEL = {"remat": True, "remat_policy": "dots"}
+CONFIGS: tuple[tuple[str, dict, dict, dict], ...] = (
     # reference-parity optimizer, memory-reduced variant
     ("adam_bf16m",
      {"optimizer": "adam", "moments_dtype": "bfloat16"},
-     {"remat": True, "remat_policy": "full"}),
+     {"remat": True, "remat_policy": "full"}, {}),
     # the reference's optimizer VERBATIM (fp32 moments) — fits since the
     # chained-timing carry-donation fix
     ("adam_fp32m",
      {"optimizer": "adam"},
-     {"remat": True, "remat_policy": "full"}),
+     {"remat": True, "remat_policy": "full"}, {}),
     # remat-policy ladder at fixed optimizer (stateless SGD isolates the
     # activation-memory axis from optimizer-state memory)
-    ("sgd_remat_off", {"optimizer": "sgd"}, {"remat": False}),
+    ("sgd_remat_off", {"optimizer": "sgd"}, {"remat": False}, {}),
     ("sgd_remat_dots", {"optimizer": "sgd"},
-     {"remat": True, "remat_policy": "dots"}),
+     {"remat": True, "remat_policy": "dots"}, {}),
     ("sgd_remat_full", {"optimizer": "sgd"},
-     {"remat": True, "remat_policy": "full"}),
+     {"remat": True, "remat_policy": "full"}, {}),
     # best-policy headline at the reference optimizer config
-    ("adam_bf16m_dots",
-     {"optimizer": "adam", "moments_dtype": "bfloat16"},
-     {"remat": True, "remat_policy": "dots"}),
+    ("adam_bf16m_dots", _DOTS_ADAM, _DOTS_MODEL, {}),
     # the TPU-idiomatic large-model optimizer (factored second moments)
     ("adafactor", {"optimizer": "adafactor"},
-     {"remat": True, "remat_policy": "full"}),
+     {"remat": True, "remat_policy": "full"}, {}),
+    # shape ladder at the headline config (VERDICT r4 #2): does a bigger
+    # batch/longer sequence lift the ~121 TFLOP/s backward rate toward the
+    # 158.6 forward rate?  b8/s512 is the adam_bf16m_dots row above.
+    ("adam_bf16m_dots_b16_s512", _DOTS_ADAM, _DOTS_MODEL,
+     {"batch_size": 16}),
+    ("adam_bf16m_dots_b32_s512", _DOTS_ADAM, _DOTS_MODEL,
+     {"batch_size": 32}),
+    ("adam_bf16m_dots_b8_s1024", _DOTS_ADAM, _DOTS_MODEL,
+     {"sequence_length": 1024}),
+    ("adam_bf16m_dots_b16_s1024", _DOTS_ADAM, _DOTS_MODEL,
+     {"batch_size": 16, "sequence_length": 1024}),
+    ("adam_bf16m_dots_b32_s1024", _DOTS_ADAM, _DOTS_MODEL,
+     {"batch_size": 32, "sequence_length": 1024}),
 )
 
 # sgd_remat_off: the no-remat rung of the ladder — measured OOM at compile
@@ -72,7 +86,13 @@ CONFIGS: tuple[tuple[str, dict, dict], ...] = (
 # (utils/timing.py::time_fn_chained) the reference's verbatim optimizer
 # measures cleanly (results/train/train_ddp_1B_train_chip_adam_fp32m.json),
 # so a failure there is a real regression again.
-EXPECTED_FAIL_OK = {"sgd_remat_off"}
+#
+# The big shape-ladder rungs may OOM (dots-remat still stores the saved
+# dot outputs per layer, which scale with B x S): if they do, the boundary
+# artifact IS the ladder's data point for that shape.
+EXPECTED_FAIL_OK = {"sgd_remat_off", "adam_bf16m_dots_b32_s512",
+                    "adam_bf16m_dots_b16_s1024",
+                    "adam_bf16m_dots_b32_s1024"}
 
 BATCH_SIZE = 8
 SEQ_LEN = 512
@@ -92,20 +112,44 @@ def _boundary_reason(suffix: str) -> str:
     from dlbb_tpu.models.configs import MODEL_CONFIGS
 
     cfg = MODEL_CONFIGS["1B"]
-    assert suffix == "sgd_remat_off", suffix
-    # stored-for-backward activation footprint is dominated by the per-layer
-    # [B, S, ffn] intermediates (bf16)
-    act_gib = (cfg.num_layers * BATCH_SIZE * SEQ_LEN
-               * cfg.ffn_intermediate * 2 / 2**30)
+    if suffix == "sgd_remat_off":
+        # stored-for-backward activation footprint is dominated by the
+        # per-layer [B, S, ffn] intermediates (bf16)
+        act_gib = (cfg.num_layers * BATCH_SIZE * SEQ_LEN
+                   * cfg.ffn_intermediate * 2 / 2**30)
+        return (
+            f"without remat every layer's forward activations stay resident "
+            f"for the backward pass ({act_gib:.1f} GiB PER stacked "
+            f"[L,B,S,ffn] bf16 intermediate at L={cfg.num_layers}, "
+            f"B={BATCH_SIZE}, S={SEQ_LEN}, ffn={cfg.ffn_intermediate}, and "
+            f"XLA keeps several plus the fp32 hidden streams: 19.30G program "
+            f"HBM vs 15.75G usable at compile) — the measured remat ladder "
+            f"points are the dots/full artifacts"
+        )
+    # shape-ladder rungs: the dots policy still saves every dot output —
+    # per layer the stacked [L,B,S,ffn]+[L,B,S,H] bf16 saves scale
+    # linearly with B x S and the 16 GiB chip runs out
+    b, s = _ladder_shape(suffix)
+    saved_gib = (cfg.num_layers * b * s
+                 * (cfg.ffn_intermediate + cfg.hidden_size) * 2 / 2**30)
     return (
-        f"without remat every layer's forward activations stay resident "
-        f"for the backward pass ({act_gib:.1f} GiB PER stacked "
-        f"[L,B,S,ffn] bf16 intermediate at L={cfg.num_layers}, "
-        f"B={BATCH_SIZE}, S={SEQ_LEN}, ffn={cfg.ffn_intermediate}, and "
-        f"XLA keeps several plus the fp32 hidden streams: 19.30G program "
-        f"HBM vs 15.75G usable at compile) — the measured remat ladder "
-        f"points are the dots/full artifacts"
+        f"dots-remat saved activations scale with B x S (~{saved_gib:.1f} "
+        f"GiB of stacked bf16 dot outputs at L={cfg.num_layers}, B={b}, "
+        f"S={s}) on the 16 GiB (15.75 usable) v5e chip alongside params + "
+        f"Adam state — this shape rung is infeasible single-chip; the "
+        f"measured ladder points are the smaller shapes"
     )
+
+
+def _ladder_shape(suffix: str) -> tuple[int, int]:
+    """(batch, seq) for a shape-ladder suffix, else the canonical shape."""
+    b, s = BATCH_SIZE, SEQ_LEN
+    for part in suffix.split("_"):
+        if part.startswith("b") and part[1:].isdigit():
+            b = int(part[1:])
+        elif part.startswith("s") and part[1:].isdigit():
+            s = int(part[1:])
+    return b, s
 
 
 def write_boundary_artifact(suffix: str, output: str, exit_code: int,
@@ -127,13 +171,13 @@ def write_boundary_artifact(suffix: str, output: str, exit_code: int,
 def _run_one(suffix: str, iters: int, output: str) -> None:
     # validate the suffix BEFORE any JAX/runtime init: a typo must fail in
     # milliseconds, not after grabbing the chip
-    match = [(t, m) for s, t, m in CONFIGS if s == suffix]
+    match = [(t, m, i) for s, t, m, i in CONFIGS if s == suffix]
     if not match:
         raise SystemExit(
             f"unknown config {suffix!r}; known: "
-            f"{[s for s, _, _ in CONFIGS]}"
+            f"{[s for s, _, _, _ in CONFIGS]}"
         )
-    training, model_over = match[0]
+    training, model_over, input_over = match[0]
 
     import jax
 
@@ -145,7 +189,7 @@ def _run_one(suffix: str, iters: int, output: str) -> None:
         "model": {"size": "1B", "attention": "full", **model_over},
         "parallelism": {"world_size": 1, "data_parallel": 1},
         "input": {"batch_size": BATCH_SIZE, "sequence_length": SEQ_LEN,
-                  "seed": 42},
+                  "seed": 42, **input_over},
         "execution": {"warmup_iterations": 2,
                       "benchmark_iterations": iters},
         "training": {"learning_rate": 1e-4, **training},
@@ -170,7 +214,7 @@ def main() -> int:
 
     return run_worker_matrix(
         __file__,
-        [s for s, _, _ in CONFIGS],
+        [s for s, _, _, _ in CONFIGS],
         only_str=lambda s: s,
         artifact_name=_artifact_name,
         expected_fail_ok=EXPECTED_FAIL_OK,

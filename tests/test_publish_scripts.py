@@ -165,7 +165,27 @@ def test_train_boundary_only_for_remat_off(monkeypatch, tmp_path):
     assert data["status"] == "infeasible"
     assert "remat" in data["reason"]
     # every other config ran
-    assert set(calls) == {s for s, _, _ in mod.CONFIGS}
+    assert set(calls) == {s for s, _, _, _ in mod.CONFIGS}
+
+
+def test_train_shape_ladder_boundary(monkeypatch, tmp_path):
+    """The big shape-ladder rungs may OOM; their boundary reason is
+    computed from the rung's own (batch, seq), and the small rungs are
+    never allowed to fail silently."""
+    mod, calls = _load_train(
+        monkeypatch, tmp_path,
+        {"adam_bf16m_dots_b32_s1024": (1, "RESOURCE_EXHAUSTED hbm\n")},
+    )
+    assert mod.main() == 0
+    art = tmp_path / ("train_ddp_1B_train_chip_adam_bf16m_dots_b32_s1024"
+                      "_infeasible.json")
+    data = json.loads(art.read_text())
+    assert data["status"] == "infeasible"
+    assert "B=32" in data["reason"] and "S=1024" in data["reason"]
+    # the smallest new rung is NOT in the expected-fail set: an OOM at
+    # b16/s512 would be a regression, not a boundary
+    assert "adam_bf16m_dots_b16_s512" not in mod.EXPECTED_FAIL_OK
+    assert mod._ladder_shape("adam_bf16m_dots_b16_s512") == (16, 512)
 
 
 def test_train_adam_fp32m_failure_is_real(monkeypatch, tmp_path):
