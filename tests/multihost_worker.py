@@ -90,6 +90,29 @@ def main(process_id: int, port: int, out_dir: str) -> None:
     agree = _resume_exists(Path(written[0]))
     assert agree is True, agree
 
+    # e2e cross-host CV branch (bench/e2e.py): a tiny forward benchmark
+    # over the global 4-device dp mesh.  The fixed-seed data layer is
+    # multi-process-correct by construction: every process materialises
+    # the identical batch, so the global device_put's same-value check
+    # passes — exactly the property this exercises.
+    from dlbb_tpu.bench.e2e import run_e2e
+
+    e2e_cfg = {
+        "experiment": {"name": "mh2_e2e"},
+        "model": {"hidden_size": 64, "num_layers": 1, "num_heads": 2,
+                  "ffn_intermediate": 128, "attention": "dense",
+                  "dtype": "float32"},
+        "parallelism": {"world_size": 1, "data_parallel": 4},
+        "input": {"batch_size": 4, "sequence_length": 32, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 3},
+    }
+    r = run_e2e(e2e_cfg, output_dir=out_dir if process_id == 0 else None,
+                verbose=False)
+    # the host-side allgather of per-host forward means: 2 entries, and
+    # the CV is a real cross-host number (>= 0), not the single-process 0
+    assert len(r["per_host_means_s"]) == 2, r["per_host_means_s"]
+    assert r["cross_host_cv"] >= 0.0
+
     print(f"WORKER-OK proc={process_id}", flush=True)
 
 
