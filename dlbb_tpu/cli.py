@@ -293,6 +293,21 @@ def _dispatch(args) -> int:
         else:
             print(f"parallelism: no train_*.json under {par_dir} — "
                   "skipped")
+        cp_dir = par_dir / "cp_scaling"
+        if any(cp_dir.glob("train_ddp_cp_s*.json")):
+            from dlbb_tpu.stats.parallelism_report import (
+                write_cp_scaling_report,
+            )
+
+            cp_rows = write_cp_scaling_report(
+                cp_dir, stats_root / "parallelism",
+            )
+            produced += 1
+            print(f"cp_scaling: {len(cp_rows)} (S, sp) cells -> "
+                  f"{stats_root / 'parallelism' / 'CP_SCALING.md'}")
+        else:
+            print(f"cp_scaling: no train_ddp_cp_s*.json under {cp_dir} — "
+                  "skipped")
         from dlbb_tpu.stats.northstar import (
             default_stats_1d_csv,
             write_northstar_report,

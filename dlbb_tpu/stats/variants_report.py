@@ -287,11 +287,18 @@ def write_variants3d_report(
         f"# 3D-shape variant comparison — {operation} "
         "(mean ms per config)",
         "",
-        "The two 1D-winning tuning variants measured on the reference's "
-        "3D LLM-shaped sweep grid, against the default-variant corpus "
+        "The tuned variants measured on the reference's 3D LLM-shaped "
+        "sweep, against the default-variant corpus "
         "(`results/3d/xla_tpu`) — the analogue of the reference tuning "
         "its CCL algorithms on the 3D shape "
-        "(`collectives/3d/launch_dsccl.sh`).  Wins per variant: "
+        "(`collectives/3d/launch_dsccl.sh`).  The two 1D winners (ring, "
+        "grid4x2) cover the FULL 3D grid; every other executable "
+        "variant covers the reference's reduced tuning grid — "
+        "allreduce, B {8,16} x S {2048,4096} x H {2048,4096}, ranks "
+        "{4,8} (`collectives/3d/dsccl.py:20-28`; 8-rank mesh shapes "
+        "rank-gate to the 8-rank rows) — via the `variants3d_tuning` "
+        "publisher stage.  Blank cells are outside a variant's grid or "
+        "memory-capped (logged skips).  Wins per variant: "
         + ", ".join(f"{n}: {wins[n]}" for n in names) + ".",
         "",
     ]

@@ -410,6 +410,41 @@ def stage_variants3d() -> None:
         ))
 
 
+# The reference's CCL tuning ran on a REDUCED 3D grid — allreduce only,
+# B {8,16} x S {2048,4096} x H {2048,4096}, ranks {4,8(,16)}
+# (``collectives/3d/dsccl.py:20-28``) — and concentrated its algorithm /
+# worker / fusion matrix there (19 result dirs, SURVEY §2.3).  This stage
+# gives EVERY executable variant rows on that grid (the full-grid
+# ``variants3d`` stage covers only the two 1D winners); rank-gated mesh
+# shapes (grid/hier need exactly 8 ranks) and memory-capped cells are
+# logged skips, like the reference's OOM holes.
+TUNING_GRID_3D = {
+    "batch_sizes": (8, 16),
+    "seq_lengths": (2048, 4096),
+    "hidden_dims": (2048, 4096),
+}
+
+
+def stage_variants3d_tuning() -> None:
+    log("3D allreduce tuning grid: ALL executable variants "
+        "(reference dsccl.py reduced grid)")
+    for name in EXECUTABLE_VARIANTS:
+        if name == "default":
+            continue  # the default corpus (results/3d) already covers it
+        log(f"  variant {name} (3D tuning grid)")
+        run_sweep(Sweep3D(
+            variant=name,
+            operations=("allreduce",),
+            batch_sizes=TUNING_GRID_3D["batch_sizes"],
+            seq_lengths=TUNING_GRID_3D["seq_lengths"],
+            hidden_dims=TUNING_GRID_3D["hidden_dims"],
+            output_dir=str(RESULTS / "variants3d" / _impl(name)),
+            max_config_seconds=8.0,
+            max_global_bytes=8 * GIB,
+            resume=RESUME,
+        ))
+
+
 def _impl(variant: str) -> str:
     return "xla_tpu" if variant == "default" else f"xla_tpu_{variant}"
 
@@ -505,6 +540,139 @@ def stage_parallelism() -> None:
         if r["winner"]:
             log(f"  winner {r['family']}: {r['member']} "
                 f"({r['step_time_mean_s']} s)")
+
+
+# Long-context CP scaling (VERDICT r4 #6): ring vs Ulysses across the
+# sequence axis the reference only ever touched as payload bytes
+# (SURVEY §5.7 — its "long context" is collective payload size; it has no
+# context parallelism).  B=1, small model, S {8192,16384,32768},
+# sp {2,4,8} on the simulated mesh.  Dense-score footprint is the binding
+# constraint on this host: Ulysses computes full-S attention per local
+# head ([B, H/P, S, S] x P devices = B*H*S^2 global), ring only a
+# [S/P, S/P] block per device (B*H*S^2/P global) — configs whose
+# estimated resident bytes exceed the cap are skipped with a committed
+# boundary artifact, like the chip ladder's OOM rungs.
+# deliberately tiny (1 layer, h=64): on this single-core host the sim
+# mesh sustains only ~2 GFLOP/s, and the S^2 attention term dominates —
+# a 2-layer h=128 model measured 86 s/step at S=8192/sp2, pricing the
+# S=32768 rows out entirely.  Both impls share the model, so the
+# ring-vs-Ulysses ordering (the signal) is preserved; 8 heads keeps
+# every sp degree Ulysses-divisible.
+CP_SCALING_MODEL = {
+    "hidden_size": 64,
+    "num_layers": 1,
+    "num_heads": 8,
+    "ffn_intermediate": 256,
+    "dtype": "float32",
+}
+CP_SEQ_LENGTHS = (8192, 16384, 32768)
+CP_SP_DEGREES = (2, 4, 8)
+# fwd scores + backward recompute/grad residency, measured-informed fudge
+CP_RESIDENCY_FACTOR = 3
+CP_FOOTPRINT_CAP = 48 * GIB  # of the 125 GiB host pool
+# Ring's total attention compute is Theta(S^2 * h) regardless of sp (P
+# blocks of (S/P)^2, and the 1-core host simulates every device
+# serially), so EVERY S=32768 ring cell costs the same ~40 min here
+# (measured anchor: 286 s/step at S=16384/sp2, x4 for S^2).  The time
+# budget admits one long-S cell: sp=8 carries the S axis; the other sp
+# degrees are covered at S<=16384 and land as logged time-cap skips.
+CP_LONG_S_SP: dict[int, tuple[int, ...]] = {32768: (8,)}
+# single measured iteration at the longest S (a second ~20-min sample
+# buys no ordering information on a sim mesh)
+CP_BENCH_ITERS = {32768: 1}
+
+
+def _cp_score_bytes(impl: str, seq: int, sp: int) -> int:
+    """Global resident bytes of the attention score tensors (fp32)."""
+    b, h = 1, CP_SCALING_MODEL["num_heads"]
+    per = b * h * seq * seq * 4
+    if impl == "ring":
+        per //= sp  # one [S/P, S/P] block per device at a time
+    return per * CP_RESIDENCY_FACTOR
+
+
+def stage_cp_scaling() -> None:
+    from dlbb_tpu.train.loop import run_train
+    from dlbb_tpu.utils.config import save_json
+
+    out = RESULTS / "parallelism" / "cp_scaling"
+    out.mkdir(parents=True, exist_ok=True)
+    log("long-context CP scaling: ring vs Ulysses, S x sp grid")
+    for seq in CP_SEQ_LENGTHS:
+        for sp in CP_SP_DEGREES:
+            for impl in ("ring", "ulysses"):
+                name = f"cp_s{seq}_sp{sp}_{impl}"
+                path = out / f"train_ddp_{name}.json"
+                if RESUME and path.exists():
+                    log(f"  [resume-skip] {name}")
+                    continue
+                # footprint cap FIRST: a cell that cannot fit in RAM at
+                # any sp must say so — blaming the time budget would
+                # misattribute the skip (Ulysses at S=32768 is
+                # footprint-bound at EVERY sp)
+                est = _cp_score_bytes(impl, seq, sp)
+                allowed_sp = CP_LONG_S_SP.get(seq, CP_SP_DEGREES)
+                if est <= CP_FOOTPRINT_CAP and sp not in allowed_sp:
+                    log(f"  [skip-time] {name}: S={seq} cells cost "
+                        "~40 min each on this single-core host "
+                        "(S^2 anchor), budget admits sp "
+                        f"{allowed_sp} only")
+                    save_json({
+                        "experiment": {"name": name},
+                        "status": "skipped_estimated_time",
+                        "reason": (
+                            f"ring-family attention compute is Theta(S^2) "
+                            f"independent of sp on a serially-simulated "
+                            f"mesh; at S={seq} each cell costs ~40 min on "
+                            f"this single-core host (measured anchor "
+                            f"286 s/step at S=16384/sp2).  The time "
+                            f"budget admits sp {list(allowed_sp)} to "
+                            f"carry the S axis; the sp axis is covered "
+                            f"at S<=16384."
+                        ),
+                    }, str(path))
+                    continue
+                if est > CP_FOOTPRINT_CAP:
+                    log(f"  [skip-mem] {name}: est. {est / GIB:.0f} GiB "
+                        f"score residency > cap {CP_FOOTPRINT_CAP / GIB:.0f}"
+                        " GiB")
+                    save_json({
+                        "experiment": {"name": name},
+                        "status": "skipped_estimated_footprint",
+                        "reason": (
+                            f"{impl} attention at S={seq}, sp={sp} holds "
+                            f"~{est / GIB:.0f} GiB of dense score tensors "
+                            f"(B*H*S^2{'/P' if impl == 'ring' else ''} "
+                            f"fp32 x residency {CP_RESIDENCY_FACTOR}) "
+                            f"against the {CP_FOOTPRINT_CAP / GIB:.0f} GiB "
+                            "cap on this 125 GiB host simulating the "
+                            "mesh in one RAM pool"
+                        ),
+                        "estimated_bytes": est,
+                        "cap_bytes": CP_FOOTPRINT_CAP,
+                    }, str(path))
+                    continue
+                log(f"  {name}")
+                config = {
+                    "experiment": {"name": name},
+                    "model": dict(CP_SCALING_MODEL, **{"attention": impl}),
+                    "parallelism": {"world_size": 1, "data_parallel": 1,
+                                    "sequence_parallel": sp},
+                    "input": {"batch_size": 1, "sequence_length": seq,
+                              "seed": 42},
+                    "execution": {
+                        "warmup_iterations": 1,
+                        "benchmark_iterations":
+                            CP_BENCH_ITERS.get(seq, 2),
+                    },
+                    "training": {"learning_rate": 1e-3},
+                }
+                run_train(config, zero_stage=0, output_dir=str(out))
+    from dlbb_tpu.stats.parallelism_report import write_cp_scaling_report
+
+    rows = write_cp_scaling_report(out, STATS / "parallelism")
+    log(f"  CP scaling: {len(rows)} (S, sp) cells "
+        "(stats/parallelism/CP_SCALING.md)")
 
 
 def stage_13b() -> None:
@@ -622,7 +790,9 @@ def stage_stats() -> None:
             process_1d_results(in_dir, STATS / "variants" / impl,
                                verbose=False)
     log("stats: variants3d")
-    for name in VARIANTS_3D:
+    # every variant with 3D rows: the two full-grid winners plus the
+    # whole executable matrix from the tuning-grid stage
+    for name in {*VARIANTS_3D, *EXECUTABLE_VARIANTS}:
         impl = _impl(name)
         in_dir = RESULTS / "variants3d" / impl
         if in_dir.exists():
@@ -654,6 +824,15 @@ def stage_stats() -> None:
     if ns:
         log(f"  northstar: {sum(ns.values())} size rows across "
             f"{list(ns)} (stats/northstar/NORTHSTAR.md)")
+    cp_dir = RESULTS / "parallelism" / "cp_scaling"
+    if any(cp_dir.glob("train_ddp_cp_s*.json")):
+        from dlbb_tpu.stats.parallelism_report import (
+            write_cp_scaling_report,
+        )
+
+        cp_rows = write_cp_scaling_report(cp_dir, STATS / "parallelism")
+        log(f"  cp_scaling: {len(cp_rows)} (S, sp) cells "
+            "(stats/parallelism/CP_SCALING.md)")
 
 
 def stage_compare() -> None:
@@ -761,6 +940,7 @@ def stage_baseline() -> None:
         ("northstar_report", STATS / "northstar" / "NORTHSTAR.md"),
         ("variants3d_report", STATS / "variants3d" / "VARIANTS3D.md"),
         ("parallelism_report", STATS / "parallelism" / "PARALLELISM.md"),
+        ("cp_scaling_report", STATS / "parallelism" / "CP_SCALING.md"),
         ("comparison_report", STATS / "compare" / "COMPARISON.md"),
     ):
         if rel.exists():
@@ -816,10 +996,12 @@ STAGES = {
     "variants": stage_variants,
     "variants16": stage_variants16,
     "variants3d": stage_variants3d,
+    "variants3d_tuning": stage_variants3d_tuning,
     "train": stage_train,
     "flagship": stage_flagship,
     "tpladder": stage_tpladder,
     "parallelism": stage_parallelism,
+    "cp_scaling": stage_cp_scaling,
     "13b": stage_13b,
     "multichip": stage_multichip,
     "stats": stage_stats,

@@ -182,10 +182,15 @@ def test_train_shape_ladder_boundary(monkeypatch, tmp_path):
     data = json.loads(art.read_text())
     assert data["status"] == "infeasible"
     assert "B=32" in data["reason"] and "S=1024" in data["reason"]
-    # the smallest new rung is NOT in the expected-fail set: an OOM at
-    # b16/s512 would be a regression, not a boundary
-    assert "adam_bf16m_dots_b16_s512" not in mod.EXPECTED_FAIL_OK
+    # every Adam shape rung is measured-infeasible on the 16 GiB chip
+    # (b16/s512 needs 16.35G of 15.75G), so all of them are boundary;
+    # the smallest STATELESS-SGD rungs are the ones that must never
+    # fail silently — an OOM there would be a regression
+    assert "adam_bf16m_dots_b16_s512" in mod.EXPECTED_FAIL_OK
+    assert "sgd_dots_b16_s512" not in mod.EXPECTED_FAIL_OK
+    assert "sgd_dots_b8_s1024" not in mod.EXPECTED_FAIL_OK
     assert mod._ladder_shape("adam_bf16m_dots_b16_s512") == (16, 512)
+    assert mod._ladder_shape("sgd_dots_b8_s1024") == (8, 1024)
 
 
 def test_train_adam_fp32m_failure_is_real(monkeypatch, tmp_path):

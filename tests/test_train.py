@@ -218,6 +218,68 @@ def test_parallelism_report(tmp_path):
     assert (tmp_path / "out" / "parallelism_comparison.csv").exists()
 
 
+def test_cp_scaling_report(tmp_path):
+    """The long-context CP scaling report joins ring/Ulysses artifacts per
+    (S, sp) cell, computes the ring/Ulysses ratio where both measured, and
+    renders footprint-capped boundary artifacts as visible skip cells
+    (the capped Ulysses column at long S is itself the finding)."""
+    import json
+
+    from dlbb_tpu.stats.parallelism_report import write_cp_scaling_report
+
+    def art(name, tokens_per_s):
+        (tmp_path / f"train_ddp_{name}.json").write_text(json.dumps({
+            "experiment": {"name": name},
+            "mesh": {"dp": 1, "sp": 2, "pp": 1, "ep": 1, "tp": 1},
+            "step_time": {"mean": 1.0},
+            "tokens_per_second": tokens_per_s,
+        }))
+
+    def boundary(name, est_gib):
+        (tmp_path / f"train_ddp_{name}.json").write_text(json.dumps({
+            "experiment": {"name": name},
+            "status": "skipped_estimated_footprint",
+            "estimated_bytes": est_gib * 2**30,
+        }))
+
+    def time_boundary(name):
+        (tmp_path / f"train_ddp_{name}.json").write_text(json.dumps({
+            "experiment": {"name": name},
+            "status": "skipped_estimated_time",
+        }))
+
+    def infeasible(name):
+        (tmp_path / f"train_ddp_{name}.json").write_text(json.dumps({
+            "experiment": {"name": name},
+            "status": "infeasible",
+        }))
+
+    art("cp_s8192_sp2_ring", 1000.0)
+    art("cp_s8192_sp2_ulysses", 1250.0)
+    art("cp_s32768_sp4_ring", 400.0)
+    boundary("cp_s32768_sp4_ulysses", 103)
+    time_boundary("cp_s32768_sp2_ring")
+    boundary("cp_s32768_sp2_ulysses", 103)
+    infeasible("cp_s32768_sp8_ring")
+    boundary("cp_s32768_sp8_ulysses", 96)
+    rows = write_cp_scaling_report(tmp_path, tmp_path / "out")
+    by = {(r["seq_len"], r["sp"]): r for r in rows}
+    assert by[(8192, 2)]["winner"] == "ulysses"
+    assert by[(8192, 2)]["ring_over_ulysses"] == 0.8
+    capped = by[(32768, 4)]
+    assert capped["winner"] == "ring (ulysses capped)"
+    assert capped["ring_over_ulysses"] is None
+    assert "103 GiB" in capped["ulysses_tokens_per_second"]
+    both_skip = by[(32768, 2)]
+    assert both_skip["winner"] is None
+    assert "estimated_time" in both_skip["ring_tokens_per_second"]
+    hard = by[(32768, 8)]
+    assert hard["winner"] is None
+    assert "infeasible" in hard["ring_tokens_per_second"]
+    assert (tmp_path / "out" / "CP_SCALING.md").exists()
+    assert (tmp_path / "out" / "cp_scaling.csv").exists()
+
+
 def test_zero3_compiles_param_allgather_pattern(devices):
     """ZeRO-3/FSDP is DECLARED (dp-sharded params); the compiled step must
     contain all-gather collectives (params gathered on use) that plain DDP
