@@ -580,6 +580,17 @@ CP_LONG_S_SP: dict[int, tuple[int, ...]] = {32768: (8,)}
 # single measured iteration at the longest S (a second ~20-min sample
 # buys no ordering information on a sim mesh)
 CP_BENCH_ITERS = {32768: 1}
+# Cells that kill the PROCESS rather than raise: XLA:CPU's in-process
+# collective rendezvous has a hard 40 s termination timeout (fatal
+# CHECK, not catchable — "Exiting to ensure a consistent program
+# state"), and at S=32768 the single core cannot bring 8 device
+# threads to the ring's collective-permute rendezvous in time
+# (observed 2026-07-31: 6/8 arrived).  The stage writes the boundary
+# artifact itself instead of re-executing the crash — this keeps
+# --fresh runs alive through the rest of the publisher (the train
+# publisher isolates this failure class with worker subprocesses;
+# one known cell doesn't warrant that machinery here).
+CP_KNOWN_INFEASIBLE = {("ring", 32768, 8)}
 
 
 def _cp_score_bytes(impl: str, seq: int, sp: int) -> int:
@@ -605,6 +616,44 @@ def stage_cp_scaling() -> None:
                 path = out / f"train_ddp_{name}.json"
                 if RESUME and path.exists():
                     log(f"  [resume-skip] {name}")
+                    continue
+                if (impl, seq, sp) in CP_KNOWN_INFEASIBLE:
+                    log(f"  [skip-infeasible] {name}: XLA:CPU rendezvous "
+                        "termination timeout (fatal CHECK; boundary "
+                        "artifact written, cell not re-executed)")
+                    save_json({
+                        "experiment": {"name": name},
+                        "status": "infeasible",
+                        "reason": (
+                            "XLA:CPU's in-process collective rendezvous "
+                            "enforces a hard 40 s termination timeout "
+                            "(rendezvous.cc, no tunable flag in this "
+                            f"jaxlib): at S={seq} each simulated device "
+                            f"computes [{seq // sp},{seq // sp}] ring "
+                            "attention blocks between collective-permute "
+                            "steps, and the single-core host cannot bring "
+                            f"all {sp} device threads to the rendezvous "
+                            "in time (observed: 'Expected 8 threads to "
+                            "join the rendezvous, but only 6 of them "
+                            "arrived on time', fatal check after 40 s).  "
+                            "Same runtime boundary class as the "
+                            "full-depth 13B training abort documented in "
+                            "docs/13b_single_chip.md.  The S axis is "
+                            "measured to 16384 (all sp degrees); on real "
+                            "TPU hardware the per-device block compute "
+                            "runs on the chip and no host-thread "
+                            "rendezvous exists."
+                        ),
+                        "observed_error": (
+                            "F0731 07:28:13 rendezvous.cc:127 Termination "
+                            "timeout for `collective permute "
+                            "RendezvousKey{...global_devices=[0..7]...}` "
+                            "of 40 seconds exceeded. Exiting to ensure a "
+                            "consistent program state. Expected 8 threads "
+                            "to join the rendezvous, but only 6 of them "
+                            "arrived on time."
+                        ),
+                    }, str(path))
                     continue
                 # footprint cap FIRST: a cell that cannot fit in RAM at
                 # any sp must say so — blaming the time budget would
@@ -953,7 +1002,11 @@ def stage_baseline() -> None:
         ladder = {}
         for p in sorted(train_dir.glob("train_*.json")):
             r = json.loads(p.read_text())
-            name = r["experiment"]["name"]
+            name = (r.get("experiment") or {}).get("name")
+            if name is None:
+                # derived joins (train_attrib_decomposition.json) share
+                # the prefix but are not ladder artifacts
+                continue
             if r.get("status") == "infeasible":
                 # capability boundaries (e.g. the no-remat rung) publish
                 # their reason, never shadow a measured artifact
