@@ -1002,11 +1002,12 @@ def stage_baseline() -> None:
         ladder = {}
         for p in sorted(train_dir.glob("train_*.json")):
             r = json.loads(p.read_text())
-            name = (r.get("experiment") or {}).get("name")
-            if name is None:
+            if "rows" in r and "method" in r:
                 # derived joins (train_attrib_decomposition.json) share
-                # the prefix but are not ladder artifacts
+                # the prefix but are not ladder artifacts; anything else
+                # missing experiment.name still fails loudly below
                 continue
+            name = r["experiment"]["name"]
             if r.get("status") == "infeasible":
                 # capability boundaries (e.g. the no-remat rung) publish
                 # their reason, never shadow a measured artifact

@@ -217,11 +217,7 @@ def test_train_unknown_only_suffix_rejected(monkeypatch, tmp_path):
         mod.main()
 
 
-def test_parallelism_stage_families_consistent():
-    """Every family member has a runnable config, every config belongs to
-    a family, and each config's mesh product fits the 8-device stage."""
-    import importlib.util
-
+def _load_baselines():
     spec = importlib.util.spec_from_file_location(
         "publish_baselines", REPO / "scripts" / "publish_baselines.py"
     )
@@ -229,6 +225,13 @@ def test_parallelism_stage_families_consistent():
     # already this test session's backend, so importing is safe
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
+    return mod
+
+
+def test_parallelism_stage_families_consistent():
+    """Every family member has a runnable config, every config belongs to
+    a family, and each config's mesh product fits the 8-device stage."""
+    mod = _load_baselines()
 
     members = {m for ms in mod.PARALLELISM_FAMILIES.values() for m in ms}
     configs = set(mod._PARALLELISM_CONFIGS)
@@ -242,6 +245,61 @@ def test_parallelism_stage_families_consistent():
         if "num_microbatches" in par:
             product //= par["num_microbatches"]
         assert product <= 8, (name, par)
+
+
+def test_cp_scaling_skip_ladder(monkeypatch, tmp_path):
+    """The cp_scaling stage's skip ladder in priority order: a
+    known-infeasible cell writes its boundary WITHOUT executing (the
+    rendezvous crash is a fatal CHECK — re-running it would kill a
+    --fresh publisher), the footprint cap wins over the time budget
+    (Ulysses at S=32768 must say 96 GiB, not 'time'), and only
+    footprint-fitting cells outside the long-S allowance get time
+    skips.  Measured cells call run_train exactly once each."""
+    mod = _load_baselines()
+    monkeypatch.setattr(mod, "RESULTS", tmp_path / "results")
+    monkeypatch.setattr(mod, "STATS", tmp_path / "stats")
+
+    ran = []
+
+    def fake_run_train(config, zero_stage=0, output_dir=None, **kw):
+        name = config["experiment"]["name"]
+        ran.append(name)
+        out = Path(output_dir) / f"train_ddp_{name}.json"
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps({
+            "experiment": {"name": name},
+            "mesh": {"dp": 1, "sp": 2, "pp": 1, "ep": 1, "tp": 1},
+            "step_time": {"mean": 1.0},
+            "tokens_per_second": 100.0,
+        }))
+        return {"tokens_per_second": 100.0}
+
+    import dlbb_tpu.train.loop as loop_mod
+
+    monkeypatch.setattr(loop_mod, "run_train", fake_run_train)
+    mod.stage_cp_scaling()
+
+    out = tmp_path / "results" / "parallelism" / "cp_scaling"
+    art = {p.stem.removeprefix("train_ddp_"): json.loads(p.read_text())
+           for p in out.glob("train_ddp_cp_*.json")}
+    # full grid accounted for: every (S, sp, impl) cell has an artifact
+    assert len(art) == 18
+    # measured cells executed exactly once each, none of the capped ones
+    assert sorted(ran) == sorted(
+        n for n, a in art.items() if "status" not in a)
+    # the rendezvous cell never executed and carries the infeasible class
+    assert art["cp_s32768_sp8_ring"]["status"] == "infeasible"
+    assert "cp_s32768_sp8_ring" not in ran
+    # Ulysses at S=32768: footprint attribution at EVERY sp (never time)
+    for sp in (2, 4, 8):
+        a = art[f"cp_s32768_sp{sp}_ulysses"]
+        assert a["status"] == "skipped_estimated_footprint", (sp, a)
+    # ring at S=32768 outside the sp allowance: time attribution
+    for sp in (2, 4):
+        a = art[f"cp_s32768_sp{sp}_ring"]
+        assert a["status"] == "skipped_estimated_time", (sp, a)
+    # the report renders over the mixed cells without error
+    assert (tmp_path / "stats" / "parallelism" / "CP_SCALING.md").exists()
 
 
 def test_reports_regeneration_is_byte_stable(tmp_path):
