@@ -445,6 +445,17 @@ def test_bench_allreduce_multichip_schema(devices):
     )
 
 
+def test_bench_latest_chip_probe():
+    """The degraded fallback points at the newest committed chip capture
+    so a bench-day outage doesn't orphan the round's chip evidence."""
+    import bench
+
+    p = bench.latest_chip_probe()
+    # this repo carries round 5's capture; newest sorts last by name
+    assert p is not None and p.startswith("results/bench_probe_r")
+    assert (bench.REPO / p).is_file()
+
+
 def test_bench_probe_backend_outcomes(monkeypatch):
     """The device-init probe runs out-of-process so a down-but-not-refusing
     tunnel (jax.devices() hanging in-process) cannot hang the driver's
