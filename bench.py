@@ -212,7 +212,8 @@ def latest_chip_probe() -> "str | None":
         m = re.search(r"_r(\d+)", p.stem)
         return int(m.group(1)) if m else -1
 
-    probes = sorted(REPO.glob("results/bench_probe_r*.json"), key=round_no)
+    probes = sorted(REPO.glob("results/bench_probe_r*.json"),
+                    key=lambda p: (round_no(p), p.name))
     return str(probes[-1].relative_to(REPO)) if probes else None
 
 

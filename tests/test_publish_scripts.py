@@ -204,6 +204,29 @@ def test_train_adam_fp32m_failure_is_real(monkeypatch, tmp_path):
     assert not list(tmp_path.glob("*adam_fp32m*_infeasible.json"))
 
 
+def test_train_missing_mode_runs_only_absent_configs(monkeypatch,
+                                                     tmp_path):
+    """--missing resumes a matrix interrupted by a tunnel outage: configs
+    with a measured OR boundary artifact are excluded; only absent ones
+    re-run."""
+    mod, calls = _load_train(monkeypatch, tmp_path, {})
+    measured = [s for s, _, _, _ in mod.CONFIGS]
+    pending = {"sgd_dots_b16_s512", "adam_bf16m_dots_b8_s1024"}
+    for s in measured:
+        if s in pending:
+            continue
+        # half land as measured artifacts, half as boundaries — both
+        # must count as "present"
+        name = mod._artifact_name(s)
+        suffix = "_infeasible" if s in mod.EXPECTED_FAIL_OK else ""
+        (tmp_path / f"{name}{suffix}.json").write_text("{}")
+    monkeypatch.setattr(sys, "argv", [
+        "publish_tpu_train.py", "--output", str(tmp_path), "--missing",
+    ])
+    assert mod.main() == 0
+    assert set(calls) == pending
+
+
 def test_train_unknown_only_suffix_rejected(monkeypatch, tmp_path):
     mod, _ = _load_train(monkeypatch, tmp_path, {})
     monkeypatch.setattr(
