@@ -425,24 +425,42 @@ TUNING_GRID_3D = {
 }
 
 
-def stage_variants3d_tuning() -> None:
-    log("3D allreduce tuning grid: ALL executable variants "
-        "(reference dsccl.py reduced grid)")
-    for name in EXECUTABLE_VARIANTS:
+def _run_tuning_grid(variants, rank_counts, label: str) -> None:
+    """One reduced-tuning-grid sweep per variant (skipping "default" —
+    the default corpus' 3d/3d16 stages already cover that grid)."""
+    for name in variants:
         if name == "default":
-            continue  # the default corpus (results/3d) already covers it
-        log(f"  variant {name} (3D tuning grid)")
+            continue
+        log(f"  variant {name} ({label})")
         run_sweep(Sweep3D(
             variant=name,
             operations=("allreduce",),
             batch_sizes=TUNING_GRID_3D["batch_sizes"],
             seq_lengths=TUNING_GRID_3D["seq_lengths"],
             hidden_dims=TUNING_GRID_3D["hidden_dims"],
+            rank_counts=rank_counts,
             output_dir=str(RESULTS / "variants3d" / _impl(name)),
             max_config_seconds=8.0,
             max_global_bytes=8 * GIB,
             resume=RESUME,
         ))
+
+
+def stage_variants3d_tuning() -> None:
+    log("3D allreduce tuning grid: ALL executable variants "
+        "(reference dsccl.py reduced grid)")
+    _run_tuning_grid(EXECUTABLE_VARIANTS, (4, 8), "3D tuning grid")
+
+
+def stage_variants3d_tuning16() -> None:
+    """The 16-rank rung of the reference's tuning grid (its
+    ``RANK_COUNTS = [4, 8, 16]``, ``collectives/3d/dsccl.py:20``):
+    the 16-rank-shaped variants + flat ring on the same reduced grid.
+    Runs in a DLBB_PUBLISH_DEVICES=16 invocation."""
+    if not _require_devices(16, "variants3d_tuning16"):
+        return
+    log("3D allreduce tuning grid @ 16 ranks")
+    _run_tuning_grid(VARIANTS_16, (16,), "3D tuning grid, 16 ranks")
 
 
 def _impl(variant: str) -> str:
@@ -839,9 +857,10 @@ def stage_stats() -> None:
             process_1d_results(in_dir, STATS / "variants" / impl,
                                verbose=False)
     log("stats: variants3d")
-    # every variant with 3D rows: the two full-grid winners plus the
-    # whole executable matrix from the tuning-grid stage
-    for name in {*VARIANTS_3D, *EXECUTABLE_VARIANTS}:
+    # every variant with 3D rows: the two full-grid winners, the whole
+    # executable matrix from the tuning-grid stage, and the
+    # 16-rank-shaped variants from its 16-rank rung
+    for name in {*VARIANTS_3D, *EXECUTABLE_VARIANTS, *VARIANTS_16}:
         impl = _impl(name)
         in_dir = RESULTS / "variants3d" / impl
         if in_dir.exists():
@@ -1051,6 +1070,7 @@ STAGES = {
     "variants16": stage_variants16,
     "variants3d": stage_variants3d,
     "variants3d_tuning": stage_variants3d_tuning,
+    "variants3d_tuning16": stage_variants3d_tuning16,
     "train": stage_train,
     "flagship": stage_flagship,
     "tpladder": stage_tpladder,
