@@ -1,0 +1,65 @@
+"""comm-lint: static verification that benchmarks match their parallelism
+plan.
+
+Two passes (see docs/analysis.md for the rule catalogue):
+
+- ``hlo``  — lower + compile every registered benchmark computation on the
+  current (usually ``--simulate N`` CPU) mesh and audit the post-SPMD HLO
+  for unexpected / missing / oversized collectives and missing buffer
+  donation (``hlo_audit``).
+- ``lint`` — AST rules over ``dlbb_tpu/`` and ``scripts/`` for host syncs
+  in timed regions, undonated train-step jits, jit-in-loop recompile
+  hazards, and unsorted set iteration (``source_lint``).
+
+CLI: ``python -m dlbb_tpu.cli analyze [hlo|lint|all] --simulate 8``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlbb_tpu.analysis.findings import (  # noqa: F401
+    SEVERITY_ERROR,
+    AnalysisReport,
+    Finding,
+)
+from dlbb_tpu.analysis.source_lint import run_source_lint  # noqa: F401
+
+
+def run_analysis(
+    which: str = "all",
+    root: Optional[str] = None,
+    json_path: Optional[str] = None,
+    verbose: bool = True,
+    strict_warnings: bool = False,
+) -> int:
+    """Run the requested passes; print the human summary; optionally write
+    the JSON report.  Returns the process exit code (0 = clean)."""
+    report = AnalysisReport()
+    if which in ("lint", "all"):
+        report.extend(run_source_lint(root=root, verbose=False))
+    if which in ("hlo", "all"):
+        # imported lazily: the lint pass must work without touching jax
+        from dlbb_tpu.analysis.hlo_audit import run_hlo_audit
+
+        hlo = run_hlo_audit(verbose=verbose)
+        if not hlo.targets_audited:
+            # every target skipped for lack of devices — a CI gate wired to
+            # our exit code must not read that as a clean audit
+            hlo.findings.append(Finding(
+                pass_name="hlo", rule="no-targets-audited",
+                severity=SEVERITY_ERROR, target="<backend>",
+                message=(
+                    f"0 HLO targets audited ({len(hlo.skipped_targets)} "
+                    "skipped for lack of devices); pass --simulate N "
+                    "(e.g. 8) to stand up a large-enough CPU mesh"
+                ),
+            ))
+        report.extend(hlo)
+    if verbose:
+        print(report.render_summary())
+    if json_path:
+        report.write_json(json_path)
+        if verbose:
+            print(f"[analyze] JSON report written to {json_path}")
+    return report.exit_code(strict_warnings=strict_warnings)

@@ -1,0 +1,179 @@
+"""Analytic expected-collective model.
+
+Maps what a benchmark *claims* to do — a registry collective from
+``comm/ops.py`` or a ``ParallelismPlan`` axis assignment — to the HLO
+collective kinds the lowered program is allowed to contain and the byte
+volume each instruction may carry.  The HLO auditor compares the compiled
+module against this; anything outside the envelope is a finding.
+
+Two layers:
+
+- ``OP_EXPECTED_KINDS`` — per registry op, the HLO kinds its SPMD encoding
+  lowers to (documented next to each entry; see also docs/analysis.md).
+- ``plan_expected_kinds`` — per parallelism axis, the kinds the axis is
+  allowed to introduce into a model/train computation (Megatron TP =>
+  all-reduce, ring sp => collective-permute, Ulysses sp => all-to-all,
+  pp => collective-permute, ZeRO dp => reduce-scatter/all-gather, ...).
+
+``wire_bytes`` converts an instruction's per-device result bytes into the
+analytic wire volume of the standard ring algorithm for its kind — the
+"plan-derived expected volume" attached to every finding.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+# Registry op -> allowed HLO collective kinds, and the kind that MUST
+# appear at least once (the op's defining primitive).
+#
+# The SPMD encodings (comm/ops.py) compose every root-rooted MPI op from
+# symmetric collectives, so e.g. broadcast/scatter/reduce legitimately
+# lower to all-reduce (psum of a masked contribution), and gather (like
+# allgather) to all-gather.  "prod" allreduce is the one all-gather-based
+# reduction (no pprod primitive) — the registry default is "sum" so the
+# audit pins all-reduce.
+OP_EXPECTED_KINDS: dict[str, dict] = {
+    "allreduce": {"required": "all-reduce", "allowed": {"all-reduce"}},
+    "allreduce_hierarchical": {
+        # one psum per mesh axis: >= 2 all-reduce instructions on a
+        # multi-axis mesh
+        "required": "all-reduce", "allowed": {"all-reduce"},
+        "min_required": 2,
+    },
+    "allgather": {"required": "all-gather", "allowed": {"all-gather"}},
+    "broadcast": {"required": "all-reduce", "allowed": {"all-reduce"}},
+    "gather": {"required": "all-gather", "allowed": {"all-gather"}},
+    "scatter": {"required": "all-reduce", "allowed": {"all-reduce"}},
+    "reduce": {"required": "all-reduce", "allowed": {"all-reduce"}},
+    "alltoall": {"required": "all-to-all", "allowed": {"all-to-all"}},
+    "sendrecv": {
+        "required": "collective-permute", "allowed": {"collective-permute"},
+    },
+    "reducescatter": {
+        "required": "reduce-scatter",
+        # XLA CPU sometimes legalises psum_scatter to all-reduce + slice
+        # (semantically identical, 2x wire volume); accept either lowering
+        # but require one of the two.
+        "allowed": {"reduce-scatter", "all-reduce"},
+        "required_any": {"reduce-scatter", "all-reduce"},
+    },
+    "barrier": {"required": "all-reduce", "allowed": {"all-reduce"}},
+}
+
+# Parallelism axis -> collective kinds that axis may introduce.
+#
+# tp additionally allows collective-permute: the fused-QKV kernel shards
+# its packed [H + 2*kv*d] output dim over tp, and the q/k/v (and
+# simplified-attention) slice boundaries do not align with the shard
+# boundaries, so GSPMD realigns with neighbour collective-permutes of
+# activation size (verified against the compiled HLO of the tiny TP
+# forward; an audit finding only if they exceed the activation-byte
+# ceiling).  The tripwire for TP mis-sharding remains all-gather: a
+# weight-sized gather means the Megatron layout collapsed to replication.
+AXIS_EXPECTED_KINDS: dict[str, set[str]] = {
+    "dp": {"all-reduce", "reduce-scatter", "all-gather"},  # DDP / ZeRO
+    "tp": {"all-reduce", "collective-permute"},  # row psum + QKV realign
+    "sp_ring": {"collective-permute"},                      # ring attention
+    "sp_ulysses": {"all-to-all"},                           # Ulysses resharding
+    "pp": {"collective-permute", "all-reduce"},             # hops + masked psum
+    "ep": {"all-reduce"},                                   # expert combine psum
+}
+
+
+def plan_expected_kinds(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
+                        ep: int = 1, attention: str = "full",
+                        zero_stage: int = 0) -> set[str]:
+    """The union of collective kinds a (plan, attention, ZeRO stage)
+    combination is allowed to lower to.  Anything else in the compiled
+    module — most importantly an all-gather in a plain TP forward — is a
+    sharding mismatch."""
+    kinds: set[str] = set()
+    if dp > 1:
+        kinds |= ({"all-reduce"} if zero_stage == 0
+                  else AXIS_EXPECTED_KINDS["dp"])
+    if tp > 1:
+        kinds |= AXIS_EXPECTED_KINDS["tp"]
+    if sp > 1:
+        kinds |= AXIS_EXPECTED_KINDS[
+            "sp_ring" if attention == "ring" else "sp_ulysses"
+        ]
+    if pp > 1:
+        kinds |= AXIS_EXPECTED_KINDS["pp"]
+    if ep > 1:
+        kinds |= AXIS_EXPECTED_KINDS["ep"]
+    return kinds
+
+
+def wire_bytes(kind: str, result_bytes: int, group_size: Optional[int]) -> int:
+    """Analytic per-device wire volume of the standard ring algorithm for
+    ``kind``, given the instruction's per-device result bytes.
+
+    all-reduce: 2(P-1)/P x buffer (reduce-scatter + all-gather phases);
+    all-gather: result is the gathered buffer, each device receives the
+    (P-1)/P of it produced elsewhere; reduce-scatter: mirrors all-gather
+    with the roles of operand/result swapped — the wire carries (P-1) x
+    the scattered shard; all-to-all: (P-1)/P of the slab changes device;
+    collective-permute: the whole buffer moves once.
+    """
+    p = group_size or 1
+    if p <= 1:
+        return 0
+    if kind == "all-reduce":
+        return int(2 * (p - 1) / p * result_bytes)
+    if kind == "all-gather":
+        return int((p - 1) / p * result_bytes)
+    if kind == "reduce-scatter":
+        return int((p - 1) * result_bytes)
+    if kind == "all-to-all":
+        return int((p - 1) / p * result_bytes)
+    if kind == "collective-permute":
+        return int(result_bytes)
+    return int(result_bytes)
+
+
+@dataclass
+class TargetExpectation:
+    """The audit contract for one lowered computation.
+
+    allowed:            collective kinds that may appear.
+    required_any:       at least one instruction of one of these kinds must
+                        appear (None = nothing required, e.g. a pure-local
+                        computation that must stay communication-free).
+    min_required:       minimum number of instructions among required_any.
+    max_bytes_per_instr: per-device result-byte ceiling per instruction
+                        (None = unchecked); catches "oversized" collectives
+                        such as a full-parameter all-gather where only an
+                        activation-sized transfer is planned.
+    expect_donation:    the computation must donate at least one input
+                        buffer (train-step convention — without it XLA
+                        keeps input and output state resident).
+    """
+
+    allowed: set[str] = field(default_factory=set)
+    required_any: Optional[set[str]] = None
+    min_required: int = 1
+    max_bytes_per_instr: Optional[int] = None
+    expect_donation: bool = False
+
+
+def op_expectation(op_name: str, payload_bytes_per_rank: int,
+                   slack: float = 1.25) -> TargetExpectation:
+    """Expectation for one ``comm/ops.py`` registry op.
+
+    ``payload_bytes_per_rank`` is the per-rank buffer size; the byte
+    ceiling allows ``slack`` headroom over the worst-case legitimate
+    instruction (the gathered [P, n] result for gather-family ops is
+    handled by callers passing the global payload size).
+    """
+    spec = OP_EXPECTED_KINDS[op_name]
+    required_any = spec.get("required_any")
+    if required_any is None:
+        required_any = {spec["required"]}
+    return TargetExpectation(
+        allowed=set(spec["allowed"]),
+        required_any=set(required_any),
+        min_required=spec.get("min_required", 1),
+        max_bytes_per_instr=int(payload_bytes_per_rank * slack),
+    )

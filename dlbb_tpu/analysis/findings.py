@@ -1,0 +1,123 @@
+"""Structured findings shared by both comm-lint passes.
+
+A finding is one violation (or notable observation) from either the HLO
+collective auditor (``hlo_audit``) or the AST source lint (``source_lint``),
+carrying enough structure for machines (JSON report consumed by CI) and
+humans (one-line rendering in the CLI summary).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+
+@dataclass
+class Finding:
+    """One comm-lint violation.
+
+    pass_name: "hlo" or "lint".
+    rule:      stable rule identifier (see docs/analysis.md catalogue).
+    severity:  "error" findings fail the run; "warning" findings do not.
+    target:    audit-target name (hlo) or repo-relative file path (lint).
+    message:   human-readable one-liner.
+    location:  "file:line" when known (lint always; hlo when the compiled
+               instruction carries source metadata).
+    details:   rule-specific structure — for HLO findings this includes the
+               op kind, shape, dtype, per-device byte volume, replica
+               groups, and the plan-derived expected volume.
+    """
+
+    pass_name: str
+    rule: str
+    severity: str
+    target: str
+    message: str
+    location: Optional[str] = None
+    details: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "pass": self.pass_name,
+            "rule": self.rule,
+            "severity": self.severity,
+            "target": self.target,
+            "message": self.message,
+            "location": self.location,
+            "details": self.details,
+        }
+
+    def render(self) -> str:
+        loc = f" ({self.location})" if self.location else ""
+        return (f"[{self.pass_name}/{self.severity}] {self.rule} "
+                f"@ {self.target}{loc}: {self.message}")
+
+
+@dataclass
+class AnalysisReport:
+    """Aggregate result of one ``analyze`` run."""
+
+    findings: list[Finding] = field(default_factory=list)
+    suppressed: int = 0
+    targets_audited: list[str] = field(default_factory=list)
+    files_linted: int = 0
+    skipped_targets: list[dict[str, str]] = field(default_factory=list)
+
+    def extend(self, other: "AnalysisReport") -> None:
+        self.findings.extend(other.findings)
+        self.suppressed += other.suppressed
+        self.targets_audited.extend(other.targets_audited)
+        self.files_linted += other.files_linted
+        self.skipped_targets.extend(other.skipped_targets)
+
+    @property
+    def errors(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_ERROR]
+
+    @property
+    def warnings(self) -> list[Finding]:
+        return [f for f in self.findings if f.severity == SEVERITY_WARNING]
+
+    def exit_code(self, strict_warnings: bool = False) -> int:
+        if self.errors:
+            return 1
+        if strict_warnings and self.warnings:
+            return 1
+        return 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "summary": {
+                "errors": len(self.errors),
+                "warnings": len(self.warnings),
+                "suppressed": self.suppressed,
+                "targets_audited": self.targets_audited,
+                "files_linted": self.files_linted,
+                "skipped_targets": self.skipped_targets,
+            },
+        }
+
+    def write_json(self, path) -> None:
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.to_dict(), indent=2, sort_keys=True))
+
+    def render_summary(self) -> str:
+        lines = []
+        for f in self.findings:
+            lines.append(f.render())
+        lines.append(
+            f"comm-lint: {len(self.errors)} error(s), "
+            f"{len(self.warnings)} warning(s), {self.suppressed} suppressed; "
+            f"{len(self.targets_audited)} HLO target(s) audited, "
+            f"{self.files_linted} file(s) linted"
+            + (f", {len(self.skipped_targets)} target(s) skipped"
+               if self.skipped_targets else "")
+        )
+        return "\n".join(lines)

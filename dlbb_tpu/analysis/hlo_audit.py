@@ -1,0 +1,405 @@
+"""Pass 1 — HLO collective auditor.
+
+Lowers every registered benchmark computation on a (usually CPU-simulated)
+mesh, compiles it, and audits the post-SPMD HLO against the analytic
+expectation model (``expectations.py``): every collective instruction must
+be of an allowed kind and within its byte envelope, the op's defining
+primitive must actually appear, and train-step computations must donate
+their state buffers.  This catches the GSPMD failure mode the framework is
+most exposed to — a sharding mismatch silently inserting an all-gather (or
+replicating a computation) *before* any device time is spent measuring it.
+
+Audit targets are plain builders ``mesh_free_callable() -> (fn, args,
+expectation)`` so the default registry below can be extended by tests (the
+seeded-violation fixtures) and future benchmarks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from dlbb_tpu.analysis.expectations import (
+    TargetExpectation,
+    op_expectation,
+    plan_expected_kinds,
+    wire_bytes,
+)
+from dlbb_tpu.analysis.findings import (
+    SEVERITY_ERROR,
+    AnalysisReport,
+    Finding,
+)
+from dlbb_tpu.analysis.hlo_parse import (
+    CollectiveInstr,
+    has_donation,
+    parse_collectives,
+)
+
+
+@dataclass
+class AuditTarget:
+    """One computation to lower + audit.
+
+    ``build()`` returns ``(fn, args)`` where ``fn`` is jittable (or already
+    a ``jax.jit`` object) and ``args`` the example arguments to lower with.
+    ``min_devices`` lets the driver skip targets the current platform
+    cannot host instead of crashing mid-audit.
+    """
+
+    name: str
+    build: Callable[[], tuple[Any, tuple]]
+    expectation: TargetExpectation
+    min_devices: int = 1
+
+
+def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
+    """Lower, compile, parse, and check one target.  Returns the findings
+    plus a meta dict (instruction inventory) for the JSON report."""
+    import jax
+
+    fn, args = target.build()
+    jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    compiled_text = compiled.as_text()
+    instrs = parse_collectives(compiled_text)
+    exp = target.expectation
+
+    findings: list[Finding] = []
+    for instr in instrs:
+        base = _instr_details(instr, exp)
+        if instr.kind not in exp.allowed:
+            findings.append(Finding(
+                pass_name="hlo",
+                rule="unexpected-collective",
+                severity=SEVERITY_ERROR,
+                target=target.name,
+                message=(
+                    f"{instr.kind} of {instr.dtype}{list(instr.shape)} "
+                    f"({instr.result_bytes} B/device) is not in the "
+                    f"plan's allowed set {sorted(exp.allowed)} — likely a "
+                    "sharding mismatch (GSPMD inserted a collective the "
+                    "parallelism plan does not account for)"
+                ),
+                location=instr.source,
+                details=base,
+            ))
+        elif (exp.max_bytes_per_instr is not None
+                and instr.result_bytes > exp.max_bytes_per_instr):
+            findings.append(Finding(
+                pass_name="hlo",
+                rule="oversized-collective",
+                severity=SEVERITY_ERROR,
+                target=target.name,
+                message=(
+                    f"{instr.kind} carries {instr.result_bytes} B/device, "
+                    f"over the plan ceiling of {exp.max_bytes_per_instr} B "
+                    "— a larger buffer than the benchmark claims to move"
+                ),
+                location=instr.source,
+                details=base,
+            ))
+    if exp.required_any:
+        hits = [i for i in instrs if i.kind in exp.required_any]
+        if len(hits) < exp.min_required:
+            findings.append(Finding(
+                pass_name="hlo",
+                rule="missing-collective",
+                severity=SEVERITY_ERROR,
+                target=target.name,
+                message=(
+                    f"expected >= {exp.min_required} instruction(s) of "
+                    f"{sorted(exp.required_any)}, found {len(hits)} — the "
+                    "benchmark does not perform the collective it claims "
+                    "(XLA may have elided or replaced it)"
+                ),
+                details={
+                    "expected_kinds": sorted(exp.required_any),
+                    "expected_min_count": exp.min_required,
+                    "found_count": len(hits),
+                    "present": [i.to_dict() for i in instrs],
+                },
+            ))
+    if exp.expect_donation and not has_donation(lowered.as_text(),
+                                                compiled_text):
+        findings.append(Finding(
+            pass_name="hlo",
+            rule="missing-donation",
+            severity=SEVERITY_ERROR,
+            target=target.name,
+            message=(
+                "no input buffer is donated (no aliasing/buffer-donor "
+                "marker in the lowered module and no input_output_alias "
+                "in the compiled one) — the step keeps input AND output "
+                "state resident, doubling state HBM"
+            ),
+            details={"expected": "donate_argnums on the step jit"},
+        ))
+    meta = {
+        "collectives": [i.to_dict() for i in instrs],
+        "num_collectives": len(instrs),
+    }
+    return findings, meta
+
+
+def _instr_details(instr: CollectiveInstr, exp: TargetExpectation) -> dict:
+    d = instr.to_dict()
+    d["expected_allowed_kinds"] = sorted(exp.allowed)
+    d["expected_max_bytes_per_instr"] = exp.max_bytes_per_instr
+    d["analytic_wire_bytes"] = wire_bytes(
+        instr.kind, instr.result_bytes, instr.group_size
+    )
+    return d
+
+
+# ---------------------------------------------------------------------------
+# default target registry
+# ---------------------------------------------------------------------------
+
+_TINY_MODEL = dict(hidden_size=64, num_layers=2, num_heads=4,
+                   ffn_intermediate=128, dtype="float32",
+                   attention="full")
+
+
+def _registry_op_target(op_name: str, num_ranks: int = 8,
+                        num_elements: int = 256) -> AuditTarget:
+    import jax.numpy as jnp
+
+    def build():
+        from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+        from dlbb_tpu.comm.ops import get_op, make_payload
+
+        op = get_op(op_name)
+        if op_name == "allreduce_hierarchical":
+            mesh = build_mesh(MeshSpec.grid(
+                (2, num_ranks // 2), ("outer", "inner")))
+            axes = ("outer", "inner")
+        else:
+            mesh = build_mesh(MeshSpec.ring(num_ranks))
+            axes = ("ranks",)
+        fn = op.build(mesh, axes)
+        x = make_payload(op, mesh, axes, num_elements, dtype=jnp.float32)
+        return fn, (x,)
+
+    per_rank = num_elements * 4  # float32 payloads
+    # gather-family results hold every rank's buffer on each device; the
+    # per-peer input kinds already carry a [P, n] slab per rank
+    if op_name in ("allgather", "gather", "scatter", "alltoall",
+                   "reducescatter"):
+        ceiling = per_rank * num_ranks
+    else:
+        ceiling = per_rank
+    exp = op_expectation(op_name, ceiling)
+    return AuditTarget(
+        name=f"comm/ops.py::{op_name}",
+        build=build,
+        expectation=exp,
+        min_devices=num_ranks,
+    )
+
+
+def _barrier_target(num_ranks: int = 8) -> AuditTarget:
+    """``build_barrier`` is the timing synchronisation point, not a
+    registry op, so it gets its own target — the barrier must stay a
+    scalar-sized all-reduce, never anything that moves real data."""
+    import jax.numpy as jnp
+
+    def build():
+        from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+        from dlbb_tpu.comm.ops import build_barrier
+
+        mesh = build_mesh(MeshSpec.ring(num_ranks))
+        fn = build_barrier(mesh, ("ranks",))
+        x = jnp.ones((num_ranks, 1), jnp.float32)
+        return fn, (x,)
+
+    return AuditTarget(
+        name="comm/ops.py::barrier",
+        build=build,
+        expectation=op_expectation("barrier", 4),  # one f32 scalar/device
+        min_devices=num_ranks,
+    )
+
+
+def _tp_forward_target(dp: int = 2, tp: int = 4) -> AuditTarget:
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        from dlbb_tpu.comm.mesh import build_parallelism_mesh
+        from dlbb_tpu.models.configs import ModelConfig
+        from dlbb_tpu.models.sharding import batch_spec
+        from dlbb_tpu.models.transformer import (
+            forward,
+            init_params_sharded,
+        )
+
+        cfg = ModelConfig(**_TINY_MODEL)
+        mesh = build_parallelism_mesh(data_parallel=dp, tensor_parallel=tp)
+        params = init_params_sharded(cfg, jax.random.key(0), mesh)
+        x = jax.device_put(
+            jnp.ones((2 * dp, 8, cfg.hidden_size), jnp.float32),
+            NamedSharding(mesh, batch_spec(mesh)),
+        )
+        fn = jax.jit(
+            lambda p, a: forward(p, a, cfg, mesh=mesh),
+            out_shardings=NamedSharding(mesh, batch_spec(mesh)),
+        )
+        return fn, (params, x)
+
+    # per-device activation shard: [B/dp, S, H] f32
+    act_bytes = (2 * dp // dp) * 8 * _TINY_MODEL["hidden_size"] * 4
+    return AuditTarget(
+        name="models/transformer.py::forward[dp,tp]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=dp, tp=tp),
+            required_any={"all-reduce"},
+            min_required=1,  # Megatron row-parallel psum (XLA may combine)
+            max_bytes_per_instr=int(act_bytes * 1.25),
+        ),
+        min_devices=dp * tp,
+    )
+
+
+def _cp_forward_target(attention: str, dp: int = 2, sp: int = 4) -> AuditTarget:
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        from dlbb_tpu.comm.mesh import build_parallelism_mesh
+        from dlbb_tpu.models.configs import ModelConfig
+        from dlbb_tpu.models.sharding import batch_spec
+        from dlbb_tpu.models.transformer import forward, init_params_sharded
+
+        cfg = ModelConfig(**{**_TINY_MODEL, "attention": attention})
+        mesh = build_parallelism_mesh(data_parallel=dp, sequence_parallel=sp)
+        params = init_params_sharded(cfg, jax.random.key(0), mesh)
+        x = jax.device_put(
+            jnp.ones((dp, 16, cfg.hidden_size), jnp.float32),
+            NamedSharding(mesh, batch_spec(mesh)),
+        )
+        fn = jax.jit(
+            lambda p, a: forward(p, a, cfg, mesh=mesh),
+            out_shardings=NamedSharding(mesh, batch_spec(mesh)),
+        )
+        return fn, (params, x)
+
+    required = ("collective-permute" if attention == "ring"
+                else "all-to-all")
+    return AuditTarget(
+        name=f"models/transformer.py::forward[sp,{attention}]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=dp, sp=sp, attention=attention),
+            required_any={required},
+            min_required=1,
+        ),
+        min_devices=dp * sp,
+    )
+
+
+def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        from dlbb_tpu.comm.mesh import build_parallelism_mesh
+        from dlbb_tpu.models.configs import ModelConfig
+        from dlbb_tpu.models.sharding import batch_spec
+        from dlbb_tpu.models.transformer import init_params_sharded
+        from dlbb_tpu.train.loop import make_train_step
+
+        import optax
+
+        cfg = ModelConfig(**_TINY_MODEL)
+        mesh = build_parallelism_mesh(data_parallel=dp)
+        params = init_params_sharded(cfg, jax.random.key(0), mesh)
+        jit_step, state = make_train_step(
+            cfg, mesh, optax.adam(1e-3), params, zero_stage=zero_stage,
+        )
+        sharding = NamedSharding(mesh, batch_spec(mesh))
+        batch = jax.device_put(
+            jnp.ones((dp, 8, cfg.hidden_size), jnp.float32), sharding)
+        tgt = jax.device_put(
+            jnp.ones((dp, 8, cfg.hidden_size), jnp.float32), sharding)
+        return jit_step, (state, batch, tgt)
+
+    return AuditTarget(
+        name=f"train/loop.py::train_step[zero{zero_stage},dp]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=8, zero_stage=zero_stage),
+            required_any={"all-reduce", "reduce-scatter"},
+            min_required=1,  # the gradient reduction must exist
+            expect_donation=True,
+        ),
+        min_devices=dp,
+    )
+
+
+def registry_op_targets() -> list[AuditTarget]:
+    """One audit target per ``comm/ops.py`` registry collective."""
+    from dlbb_tpu.comm.ops import OPERATIONS
+
+    return [_registry_op_target(name) for name in sorted(OPERATIONS)]
+
+
+def default_targets() -> list[AuditTarget]:
+    """The repo's standing audit surface: every registry collective, the
+    TP/sequence-parallel model forwards (the e2e benchmark's jit), and the
+    DDP + ZeRO-1 train steps."""
+    targets = registry_op_targets()
+    targets.append(_barrier_target())
+    targets.append(_tp_forward_target())
+    targets.append(_cp_forward_target("ring"))
+    targets.append(_cp_forward_target("ulysses"))
+    targets.append(_train_step_target(zero_stage=0))
+    targets.append(_train_step_target(zero_stage=1))
+    return targets
+
+
+def run_hlo_audit(
+    targets: Optional[Sequence[AuditTarget]] = None,
+    verbose: bool = False,
+) -> AnalysisReport:
+    """Audit ``targets`` (default: the standing registry) on the current
+    backend.  Targets needing more devices than available are recorded as
+    skipped, not failed — the CLI's ``--simulate N`` controls the mesh."""
+    import jax
+
+    report = AnalysisReport()
+    n_devices = len(jax.devices())
+    for target in targets if targets is not None else default_targets():
+        if target.min_devices > n_devices:
+            report.skipped_targets.append({
+                "target": target.name,
+                "reason": (f"needs {target.min_devices} devices, "
+                           f"{n_devices} available"),
+            })
+            continue
+        try:
+            findings, _meta = audit_target(target)
+        except Exception as e:  # noqa: BLE001 — one target's lowering
+            # failure must not abort the audit of the rest (same per-config
+            # containment convention as bench/runner.run_sweep); it is still
+            # an error finding, not a silent skip
+            report.findings.append(Finding(
+                pass_name="hlo", rule="audit-crash",
+                severity=SEVERITY_ERROR, target=target.name,
+                message=f"audit raised {type(e).__name__}: {e}",
+            ))
+            if verbose:
+                print(f"[hlo] {target.name}: CRASH ({type(e).__name__})")
+            continue
+        report.findings.extend(findings)
+        report.targets_audited.append(target.name)
+        if verbose:
+            status = "FAIL" if findings else "ok"
+            print(f"[hlo] {target.name}: {status} "
+                  f"({_meta['num_collectives']} collective(s))")
+    return report

@@ -1,0 +1,161 @@
+"""Parser for collective instructions in compiled (post-SPMD) HLO text.
+
+The auditor reads ``jit(fn).lower(args).compile().as_text()`` — the
+optimized HLO module *after* GSPMD partitioning — because that is where
+XLA-inserted collectives live; the pre-partitioning StableHLO only shows
+sharding annotations, not the all-gathers a sharding mismatch smuggles in.
+
+Instruction grammar handled (CPU and TPU backends emit the same shapes):
+
+    %all-reduce.1 = f32[1,256]{1,0} all-reduce(f32[1,256]{1,0} %p), \
+        channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, ..., \
+        metadata={... source_file="..." source_line=96}
+    ROOT %all-gather = f32[64,32]{1,0} all-gather(f32[8,32]{1,0} %dot), \
+        channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}, ...
+    %collective-permute = ... , source_target_pairs={{0,1},{1,2}}
+
+Both replica-group syntaxes are parsed: the explicit nested-brace list and
+the iota form ``[groups,size]<=[n]``.  Async pairs count once: the
+``-start`` op is parsed, the ``-done`` op is ignored.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from math import prod
+from typing import Optional
+
+COLLECTIVE_KINDS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# HLO primitive-type byte widths
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+# the result type may be a variadic tuple with /*index=N*/ comments, so
+# the type group matches lazily up to the first collective keyword that is
+# directly followed by its operand paren
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*(?P<type>\(?[a-z0-9]+\[.+?)\s"
+    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<start>-start)?\("
+)
+_ARRAY_TYPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+_GROUPS_BRACE_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}(?=[,\s)]|$)")
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}(?=[,\s)]|$)")
+_META_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
+
+
+@dataclass
+class CollectiveInstr:
+    """One collective instruction in compiled HLO."""
+
+    kind: str                       # one of COLLECTIVE_KINDS
+    dtype: str                      # result element type (first array)
+    shape: tuple[int, ...]          # result shape (first array)
+    result_bytes: int               # summed over all result arrays
+    replica_groups: str             # raw groups / pairs text
+    group_count: Optional[int]
+    group_size: Optional[int]
+    source: Optional[str]           # "file:line" from HLO metadata
+    raw: str = field(repr=False, default="")
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "dtype": self.dtype,
+            "shape": list(self.shape),
+            "result_bytes": self.result_bytes,
+            "replica_groups": self.replica_groups,
+            "group_count": self.group_count,
+            "group_size": self.group_size,
+            "source": self.source,
+        }
+
+
+def _parse_arrays(type_text: str) -> list[tuple[str, tuple[int, ...]]]:
+    out = []
+    for dtype, dims in _ARRAY_TYPE_RE.findall(type_text):
+        shape = tuple(int(d) for d in dims.split(",")) if dims else ()
+        out.append((dtype, shape))
+    return out
+
+
+def _array_bytes(dtype: str, shape: tuple[int, ...]) -> int:
+    return _DTYPE_BYTES.get(dtype, 4) * int(prod(shape)) if shape else \
+        _DTYPE_BYTES.get(dtype, 4)
+
+
+def _parse_groups(line: str) -> tuple[str, Optional[int], Optional[int]]:
+    m = _GROUPS_BRACE_RE.search(line)
+    if m:
+        groups = [g for g in m.group(1).split("},{")]
+        sizes = {len([x for x in g.strip("{}").split(",") if x])
+                 for g in groups}
+        size = sizes.pop() if len(sizes) == 1 else None
+        return "{" + m.group(1) + "}", len(groups), size
+    m = _GROUPS_IOTA_RE.search(line)
+    if m:
+        count, size = int(m.group(1)), int(m.group(2))
+        return line[m.start(): line.find("]", m.end()) + 1], count, size
+    m = _PAIRS_RE.search(line)
+    if m:
+        pairs = m.group(1).count("},{") + 1
+        return "{" + m.group(1) + "}", pairs, 2
+    return "", None, None
+
+
+def parse_collectives(hlo_text: str) -> list[CollectiveInstr]:
+    """All collective instructions in an optimized-HLO module dump."""
+    out = []
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if m is None:
+            continue
+        arrays = _parse_arrays(m.group("type"))
+        kind = m.group("kind")
+        if m.group("start") and arrays:
+            # async start ops return (operand, result, ...) scratch tuples;
+            # the payload is the result array, whose size relative to the
+            # operand depends on the kind: reduce-scatter shrinks by the
+            # group size (result is the smallest element), all-gather grows
+            # (largest), the rest are size-preserving (either extreme works)
+            sizes = [_array_bytes(d, s) for d, s in arrays]
+            pick = min if kind == "reduce-scatter" else max
+            idx = sizes.index(pick(sizes))
+            payload = sizes[idx]
+            dtype, shape = arrays[idx]
+        else:
+            payload = sum(_array_bytes(d, s) for d, s in arrays)
+            dtype, shape = arrays[0] if arrays else ("", ())
+        groups, count, size = _parse_groups(line)
+        meta = _META_RE.search(line)
+        source = f"{meta.group(1)}:{meta.group(2)}" if meta else None
+        out.append(CollectiveInstr(
+            kind=m.group("kind"), dtype=dtype, shape=shape,
+            result_bytes=payload, replica_groups=groups,
+            group_count=count, group_size=size, source=source, raw=line,
+        ))
+    return out
+
+
+def has_donation(lowered_text: str, compiled_text: str) -> bool:
+    """True when the computation donates at least one input buffer:
+    ``tf.aliasing_output``/``jax.buffer_donor`` arg attributes in the
+    lowered StableHLO, or an ``input_output_alias`` table in the compiled
+    module header."""
+    return ("tf.aliasing_output" in lowered_text
+            or "jax.buffer_donor" in lowered_text
+            or "input_output_alias={ {" in compiled_text
+            or "input_output_alias={{" in compiled_text)

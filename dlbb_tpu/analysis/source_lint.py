@@ -1,0 +1,440 @@
+"""Pass 2 — AST source lint.
+
+Custom rules over ``dlbb_tpu/`` and ``scripts/`` for the failure modes a
+distributed benchmark repo cares about and generic linters do not:
+
+- ``host-sync-in-timed-region``: ``block_until_ready`` / ``device_get`` /
+  ``float(...)`` / ``np.asarray(...)`` inside a timed region, except
+  through the ``utils/timing.py`` API or as the region's final bracketing
+  sync.  A mid-region host sync serialises the device pipeline into the
+  measurement and corrupts the number being published.
+- ``missing-donation``: a train-step jit (``jax.jit(step)`` /
+  ``jax.jit(train_step)`` — any traced function whose name contains
+  "step" or "train") without ``donate_argnums``/``donate_argnames``;
+  without donation XLA keeps input and output state simultaneously
+  resident.
+- ``jit-in-loop``: ``jax.jit`` of a lambda or in-loop ``def`` closing over
+  the loop variable — every iteration creates a fresh callable and
+  therefore a fresh trace + compile (the Python-scalar-capture recompile
+  hazard).  Warning severity (a name-resolution heuristic); CI runs with
+  ``--strict-warnings`` so it still gates.
+- ``unsorted-set-iteration``: a ``for`` statement iterating directly over
+  a set literal / ``set(...)`` call — hash-order dependent, so publish
+  scripts reprocess artifacts in a different order run to run (the
+  round-5 ADVICE nondeterminism finding, generalised).
+
+Timed regions are detected syntactically: the body of ``with Timer()``
+(also ``with Timer() as t``), and statements strictly between
+``<var> = time.perf_counter()`` and the statement consuming
+``time.perf_counter() - <var>`` in the same block.
+
+Suppression: ``# comm-lint: disable=rule[,rule2]`` trailing on the line
+(or on the line directly above), ``# comm-lint: disable-file=rule`` near
+the top of the file.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import tokenize
+from pathlib import Path
+from typing import Iterable, Optional
+
+from dlbb_tpu.analysis.findings import (
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    AnalysisReport,
+    Finding,
+)
+
+LINT_RULES = (
+    "host-sync-in-timed-region",
+    "missing-donation",
+    "jit-in-loop",
+    "unsorted-set-iteration",
+)
+
+# Files whose whole purpose is host synchronisation around measurement.
+TIMING_API_FILES = ("utils/timing.py",)
+# Calls through the sanctioned timing API are never host-sync findings.
+TIMING_API_NAMES = {
+    "force_completion", "calibrate_fetch_overhead",
+    "single_iteration_estimate", "time_fn_per_iter", "time_fn_chained",
+    "time_collective",
+}
+_SYNC_CALL_NAMES = {"block_until_ready", "device_get"}
+_SYNC_WRAPPERS = {"float", "int"}
+_NP_SYNC_ATTRS = {"asarray", "array"}
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+# ---------------------------------------------------------------------------
+
+
+class Suppressions:
+    def __init__(self, source: str):
+        self.line_rules: dict[int, set[str]] = {}
+        self.file_rules: set[str] = set()
+        self.hits = 0
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                text = tok.string.lstrip("# ").strip()
+                if not text.startswith("comm-lint:"):
+                    continue
+                directive = text[len("comm-lint:"):].strip()
+                if directive.startswith("disable-file="):
+                    rules = directive[len("disable-file="):]
+                    self.file_rules |= {r.strip() for r in rules.split(",")}
+                elif directive.startswith("disable="):
+                    rules = directive[len("disable="):]
+                    self.line_rules.setdefault(tok.start[0], set()).update(
+                        r.strip() for r in rules.split(",")
+                    )
+        except tokenize.TokenError:
+            pass
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_rules:
+            self.hits += 1
+            return True
+        for ln in (line, line - 1):
+            if rule in self.line_rules.get(ln, set()):
+                self.hits += 1
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted name of a call's function, e.g. "jax.jit" or "Timer"."""
+    parts = []
+    f = node.func
+    while isinstance(f, ast.Attribute):
+        parts.append(f.attr)
+        f = f.value
+    if isinstance(f, ast.Name):
+        parts.append(f.id)
+    return ".".join(reversed(parts))
+
+
+def _is_perf_counter_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _call_name(node).endswith("perf_counter"))
+
+
+def _free_names(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def _sync_calls(stmt: ast.stmt) -> Iterable[tuple[ast.Call, str]]:
+    """(call, description) for every host-sync call inside ``stmt``."""
+    for node in ast.walk(stmt):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        short = name.rsplit(".", 1)[-1]
+        if short in TIMING_API_NAMES:
+            continue  # sanctioned timing API
+        if short in _SYNC_CALL_NAMES:
+            yield node, name
+        elif name in _SYNC_WRAPPERS and node.args and not isinstance(
+                node.args[0], ast.Constant):
+            # float(x)/int(x) on a non-literal forces the value to host
+            yield node, f"{name}() on a device value"
+        elif short in _NP_SYNC_ATTRS and name.split(".")[0] in ("np",
+                                                               "numpy"):
+            yield node, name
+        elif short == "item" and isinstance(node.func, ast.Attribute):
+            yield node, ".item()"
+
+
+# ---------------------------------------------------------------------------
+# rule implementations
+# ---------------------------------------------------------------------------
+
+
+def _timed_with_blocks(tree: ast.AST) -> Iterable[ast.With]:
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            ctx = item.context_expr
+            if isinstance(ctx, ast.Call) and _call_name(ctx).rsplit(
+                    ".", 1)[-1] == "Timer":
+                yield node
+                break
+
+
+def _check_timed_with(node: ast.With, path: str, findings: list[Finding]):
+    last = node.body[-1]
+    for stmt in node.body:
+        for call, desc in _sync_calls(stmt):
+            if stmt is last:
+                continue  # bracketing sync closing the measurement
+            findings.append(Finding(
+                pass_name="lint",
+                rule="host-sync-in-timed-region",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    f"{desc} inside a Timer block (before its final "
+                    "statement) serialises device work into the "
+                    "measurement; use the utils/timing.py API or move the "
+                    "sync to the region boundary"
+                ),
+                location=f"{path}:{call.lineno}",
+                details={"sync": desc, "region": f"with Timer() at line "
+                                                 f"{node.lineno}"},
+            ))
+
+
+def _check_perf_counter_regions(tree: ast.AST, path: str,
+                                findings: list[Finding]):
+    """Statements strictly between ``t = time.perf_counter()`` and the
+    statement consuming ``perf_counter() - t`` are a timed region."""
+    for scope in ast.walk(tree):
+        body = getattr(scope, "body", None)
+        if not isinstance(body, list):
+            continue
+        for blk in (body, getattr(scope, "orelse", None),
+                    getattr(scope, "finalbody", None)):
+            if not isinstance(blk, list):
+                continue
+            self_vars: dict[str, int] = {}  # var -> index of t0 assignment
+            for idx, stmt in enumerate(blk):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and _is_perf_counter_call(stmt.value)):
+                    self_vars[stmt.targets[0].id] = idx
+                    continue
+                # does this statement close a region? (perf_counter() - t)
+                closed = set()
+                for node in ast.walk(stmt):
+                    if (isinstance(node, ast.BinOp)
+                            and isinstance(node.op, ast.Sub)
+                            and _is_perf_counter_call(node.left)
+                            and isinstance(node.right, ast.Name)
+                            and node.right.id in self_vars):
+                        closed.add(node.right.id)
+                for var in closed:
+                    start = self_vars.pop(var)
+                    # the statement directly before the delta is the
+                    # bracketing sync closing the measurement (e.g.
+                    # ``float(loss)`` then ``t = perf_counter() - t0``) —
+                    # same exemption as a Timer block's final statement
+                    for mid in blk[start + 1: idx - 1]:
+                        for call, desc in _sync_calls(mid):
+                            findings.append(Finding(
+                                pass_name="lint",
+                                rule="host-sync-in-timed-region",
+                                severity=SEVERITY_ERROR,
+                                target=path,
+                                message=(
+                                    f"{desc} between "
+                                    f"{var} = time.perf_counter() and its "
+                                    "delta serialises device work into "
+                                    "the measurement; use the "
+                                    "utils/timing.py API"
+                                ),
+                                location=f"{path}:{call.lineno}",
+                                details={"sync": desc,
+                                         "region": f"perf_counter span "
+                                                   f"'{var}'"},
+                            ))
+
+
+def _check_donation(tree: ast.AST, path: str, findings: list[Finding]):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or _call_name(node) not in (
+                "jax.jit", "jit"):
+            continue
+        if not node.args:
+            continue
+        fn = node.args[0]
+        fn_name = fn.id if isinstance(fn, ast.Name) else None
+        if fn_name is None or not ("step" in fn_name or "train" in fn_name):
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if not kwargs & {"donate_argnums", "donate_argnames"}:
+            findings.append(Finding(
+                pass_name="lint",
+                rule="missing-donation",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    f"jax.jit({fn_name}) looks like a train-step jit but "
+                    "donates no arguments — without donate_argnums the "
+                    "input and output state are simultaneously resident "
+                    "(2x state HBM)"
+                ),
+                location=f"{path}:{node.lineno}",
+                details={"function": fn_name},
+            ))
+
+
+def _check_jit_in_loop(tree: ast.AST, path: str, findings: list[Finding]):
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        loop_vars: set[str] = set()
+        if isinstance(loop, ast.For):
+            loop_vars = {n.id for n in ast.walk(loop.target)
+                         if isinstance(n, ast.Name)}
+        in_loop_defs = {
+            d.name: d for d in ast.walk(loop)
+            if isinstance(d, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or _call_name(node) not in (
+                    "jax.jit", "jit", "jax.pmap", "pmap"):
+                continue
+            if not node.args:
+                continue
+            fn = node.args[0]
+            if isinstance(fn, ast.Lambda):
+                traced, what = fn.body, "lambda ..."
+            elif isinstance(fn, ast.Name) and fn.id in in_loop_defs:
+                # a def in the loop body is a fresh function object per
+                # iteration, exactly like an inline lambda
+                traced, what = in_loop_defs[fn.id], fn.id
+            else:
+                continue
+            if not loop_vars or _free_names(traced) & loop_vars:
+                findings.append(Finding(
+                    pass_name="lint",
+                    rule="jit-in-loop",
+                    severity=SEVERITY_WARNING,
+                    target=path,
+                    message=(
+                        f"jax.jit({what}) inside a loop creates a "
+                        "fresh callable — and a fresh trace + XLA compile "
+                        "— every iteration (Python-scalar capture "
+                        "recompile hazard); hoist the jit and pass the "
+                        "varying value as an argument"
+                    ),
+                    location=f"{path}:{node.lineno}",
+                    details={"loop_line": loop.lineno},
+                ))
+
+
+def _check_set_iteration(tree: ast.AST, path: str, findings: list[Finding]):
+    def is_set_expr(e: ast.AST) -> bool:
+        if isinstance(e, ast.Set):
+            return True
+        if isinstance(e, ast.Call) and _call_name(e) == "set":
+            return True
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.BitOr):
+            return is_set_expr(e.left) or is_set_expr(e.right)
+        return False
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and is_set_expr(node.iter):
+            findings.append(Finding(
+                pass_name="lint",
+                rule="unsorted-set-iteration",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    "iterating directly over a set is hash-order "
+                    "dependent — artifact/publishing order changes run to "
+                    "run; wrap the set in sorted(...)"
+                ),
+                location=f"{path}:{node.iter.lineno}",
+                details={},
+            ))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source: str, path: str) -> tuple[list[Finding], int]:
+    """Lint one file's source text; returns (findings, suppressed_count)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(
+            pass_name="lint", rule="syntax-error", severity=SEVERITY_ERROR,
+            target=path, message=f"file does not parse: {e}",
+            location=f"{path}:{e.lineno or 0}",
+        )], 0
+
+    findings: list[Finding] = []
+    norm = path.replace("\\", "/")
+    if not norm.endswith(TIMING_API_FILES):
+        for block in _timed_with_blocks(tree):
+            _check_timed_with(block, path, findings)
+        _check_perf_counter_regions(tree, path, findings)
+    _check_donation(tree, path, findings)
+    _check_jit_in_loop(tree, path, findings)
+    _check_set_iteration(tree, path, findings)
+
+    sup = Suppressions(source)
+    kept = []
+    for f in findings:
+        line = int(f.location.rsplit(":", 1)[1]) if f.location else 0
+        if not sup.suppressed(f.rule, line):
+            kept.append(f)
+    return kept, sup.hits
+
+
+DEFAULT_LINT_DIRS = ("dlbb_tpu", "scripts")
+
+
+def run_source_lint(
+    root: Optional[str] = None,
+    paths: Optional[Iterable[str]] = None,
+    verbose: bool = False,
+) -> AnalysisReport:
+    """Lint every ``*.py`` under ``root``'s default dirs (or explicit
+    ``paths``)."""
+    report = AnalysisReport()
+    if paths is None:
+        base = Path(root or ".")
+        files = sorted(
+            p for d in DEFAULT_LINT_DIRS
+            for p in (base / d).rglob("*.py") if p.is_file()
+        )
+        if not files:
+            # a typo'd --root (or wrong cwd) must not read as a clean gate
+            report.findings.append(Finding(
+                pass_name="lint", rule="no-files-linted",
+                severity=SEVERITY_ERROR, target=str(base),
+                message=(
+                    f"no Python files under {'/'.join(DEFAULT_LINT_DIRS)} "
+                    f"of {base.resolve()}; is --root the repo root?"
+                ),
+            ))
+            return report
+    else:
+        files = [Path(p) for p in paths]
+    for p in files:
+        rel = str(p)
+        try:
+            source = p.read_text()
+        except OSError as e:
+            report.findings.append(Finding(
+                pass_name="lint", rule="io-error",
+                severity=SEVERITY_ERROR, target=rel,
+                message=f"cannot read: {e}",
+            ))
+            continue
+        findings, suppressed = lint_source(source, rel)
+        report.findings.extend(findings)
+        report.suppressed += suppressed
+        report.files_linted += 1
+        if verbose and findings:
+            print(f"[lint] {rel}: {len(findings)} finding(s)")
+    return report

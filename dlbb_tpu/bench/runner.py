@@ -189,6 +189,21 @@ def _check_variant_flags(variant: Variant) -> None:
             f"{missing}; relaunch the process with them set (process-start "
             "option; cannot be applied after backend init)"
         )
+    from dlbb_tpu.compat import supports_compiler_option
+
+    unsupported = [
+        k for k, v in variant.compiler_options
+        if not supports_compiler_option(k, v)
+    ]
+    if unsupported:
+        raise RuntimeError(
+            f"variant {variant.name!r} needs per-computation compiler "
+            f"option(s) {unsupported}, which this jaxlib's compile path "
+            "rejects (protobuf reflection cannot set repeated DebugOptions "
+            "fields); the variant cannot run — and cannot be labeled "
+            "honestly — on this jaxlib; upgrade jaxlib to one whose PJRT "
+            "compile path accepts these options"
+        )
 
 
 def _build_fn(op_name: str, variant: Variant, mesh, axes, root: int):

@@ -96,6 +96,26 @@ def build_parser() -> argparse.ArgumentParser:
     rp.add_argument("--results", default="results",
                     help="results tree root (parallelism artifacts)")
 
+    an = sub.add_parser(
+        "analyze",
+        help="comm-lint: static HLO collective audit + source lint "
+             "(verifies benchmarks match their parallelism plan, no TPU "
+             "needed — runs on the --simulate mesh)",
+    )
+    an.add_argument("which", nargs="?", default="all",
+                    choices=("hlo", "lint", "all"),
+                    help="which pass to run (default: all)")
+    an.add_argument("--simulate", type=int, default=0, metavar="N",
+                    help="use an N-device CPU-simulated mesh for the HLO "
+                         "audit (targets needing more devices than "
+                         "available are skipped)")
+    an.add_argument("--json", default=None, metavar="PATH",
+                    help="write the machine-readable findings report here")
+    an.add_argument("--root", default=".",
+                    help="repo root for the source lint (default: cwd)")
+    an.add_argument("--strict-warnings", action="store_true",
+                    help="exit nonzero on warnings too")
+
     tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
     tr.add_argument("--simulate", type=int, default=0, metavar="N")
@@ -328,6 +348,14 @@ def _dispatch(args) -> int:
                   "point at the committed trees")
             return 1
         return 0
+
+    if args.cmd == "analyze":
+        from dlbb_tpu.analysis import run_analysis
+
+        return run_analysis(
+            which=args.which, root=args.root, json_path=args.json,
+            strict_warnings=args.strict_warnings,
+        )
 
     if args.cmd == "e2e":
         try:

@@ -42,6 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dlbb_tpu.comm.mesh import mesh_num_ranks
+from dlbb_tpu.compat import axis_size, shard_map
 
 
 @dataclass(frozen=True)
@@ -69,7 +70,7 @@ def _rank_id(axes: Sequence[str]) -> jax.Array:
     """Linearised rank index over possibly-multiple mesh axes."""
     idx = jnp.int32(0)
     for a in axes:
-        idx = idx * jax.lax.axis_size(a) + jax.lax.axis_index(a)
+        idx = idx * axis_size(a) + jax.lax.axis_index(a)
     return idx
 
 
@@ -81,7 +82,7 @@ def _specs(mesh: Mesh, axes: Sequence[str], ndim: int) -> P:
 def _wrap(mesh: Mesh, axes: Sequence[str], body, in_ndim: int, out_ndim: int):
     spec_in = _specs(mesh, axes, in_ndim)
     spec_out = _specs(mesh, axes, out_ndim)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=spec_in, out_specs=spec_out)
+    fn = shard_map(body, mesh=mesh, in_specs=spec_in, out_specs=spec_out)
     return jax.jit(fn)
 
 

@@ -222,8 +222,9 @@ def _flash_dispatch(q, k, v, config: ModelConfig, mesh, sp_axis: str):
         and mesh.shape["tp"] > 1 else None
     )
     if dp is not None or tp is not None:
-        from jax import shard_map
         from jax.sharding import PartitionSpec as P
+
+        from dlbb_tpu.compat import shard_map
 
         if kvh != n and tp is not None and kvh % mesh.shape[tp] != 0:
             # the head axis is tp-sharded; kv_heads not divisible by

@@ -64,7 +64,9 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
+
+from dlbb_tpu.compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dlbb_tpu.models.configs import ModelConfig
@@ -157,11 +159,14 @@ def pipeline_1f1b_grads(
     layer_specs = jax.tree.map(lambda _: P(pp_axis), params["layers"])
     aux_cot = moe_aux_weight / (config.num_layers * m)
 
-    def stage_local(layers_local, lnf, x, tgt):
-        pp = lax.axis_index(pp_axis)
+    def stage_local(sid, layers_local, lnf, x, tgt):
+        # the stage index arrives as a pp-sharded [1] array rather than
+        # lax.axis_index: under a partial-auto shard_map the latter lowers
+        # to a PartitionId instruction the SPMD partitioner rejects
+        pp = sid[0]
         is_last = pp == n_stages - 1
         lnf = jax.tree.map(
-            lambda t: lax.pcast(t, (pp_axis,), to="varying"), lnf
+            lambda t: pcast(t, (pp_axis,), to="varying"), lnf
         )
         mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
         tgt_mb = tgt.reshape(m, tgt.shape[0] // m, *tgt.shape[1:])
@@ -187,7 +192,7 @@ def pipeline_1f1b_grads(
             return y, loss, aux
 
         def var(t):  # carry entries must be pp-varying
-            return lax.pcast(t, (pp_axis,), to="varying")
+            return pcast(t, (pp_axis,), to="varying")
 
         mb_shape = mb[0].shape
         grads0 = jax.tree.map(
@@ -291,13 +296,14 @@ def pipeline_1f1b_grads(
         dlnf = lax.psum(final["dlnf"], pp_axis)   # real only where loss was
         return final["grads"], dlnf, loss, aux
 
+    stage_ids = jnp.arange(n_stages, dtype=jnp.int32)
     grads_layers, dlnf, loss, aux = shard_map(
         stage_local,
         mesh=mesh,
-        in_specs=(layer_specs, P(), P(), P()),
+        in_specs=(P(pp_axis), layer_specs, P(), P(), P()),
         out_specs=(layer_specs, P(), P(), P()),
         axis_names={pp_axis},
-    )(params["layers"], params["ln_f"], x, targets)
+    )(stage_ids, params["layers"], params["ln_f"], x, targets)
     total_loss = loss + moe_aux_weight * aux
     grads = {
         "layers": jax.tree.map(
@@ -348,13 +354,15 @@ def pipeline_forward(
 
     layer_specs = jax.tree.map(lambda _: P(pp_axis), params["layers"])
 
-    def stage_local(layers_local, x):
-        # layers_local: this stage's [L/pp, ...] block; x: full [B, S, H]
-        pp = lax.axis_index(pp_axis)
+    def stage_local(sid, layers_local, x):
+        # layers_local: this stage's [L/pp, ...] block; x: full [B, S, H];
+        # sid: pp-sharded [1] stage index (lax.axis_index would lower to a
+        # PartitionId the SPMD partitioner rejects under partial-auto)
+        pp = sid[0]
         mb = x.reshape(m, x.shape[0] // m, *x.shape[1:])
-        state = lax.pcast(jnp.zeros_like(mb[0]), (pp_axis,), to="varying")
-        outputs = lax.pcast(jnp.zeros_like(mb), (pp_axis,), to="varying")
-        aux0 = lax.pcast(jnp.zeros((), jnp.float32), (pp_axis,),
+        state = pcast(jnp.zeros_like(mb[0]), (pp_axis,), to="varying")
+        outputs = pcast(jnp.zeros_like(mb), (pp_axis,), to="varying")
+        aux0 = pcast(jnp.zeros((), jnp.float32), (pp_axis,),
                          to="varying")
 
         def local_fwd(h):
@@ -406,13 +414,14 @@ def pipeline_forward(
         aux_total = lax.psum(aux_sum, pp_axis)
         return outputs.reshape(x.shape), aux_total
 
+    stage_ids = jnp.arange(n_stages, dtype=jnp.int32)
     y, aux_total = shard_map(
         stage_local,
         mesh=mesh,
-        in_specs=(layer_specs, P()),
+        in_specs=(P(pp_axis), layer_specs, P()),
         out_specs=(P(), P()),
         axis_names={pp_axis},
-    )(params["layers"], x)
+    )(stage_ids, params["layers"], x)
     out = _layernorm(y, params["ln_f"]["scale"], params["ln_f"]["bias"])
     if with_aux:
         return out, aux_total / (config.num_layers * m)

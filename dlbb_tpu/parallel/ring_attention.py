@@ -31,6 +31,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlbb_tpu.compat import shard_map
+
 _NEG_INF = -1e30  # finite mask value: avoids exp(-inf + inf) = nan in the
 # online-softmax rescale when a block is fully masked
 
@@ -133,7 +135,7 @@ def ring_attention(
         )
     bspec = tuple(a for a in batch_axes if a in mesh.axis_names) or None
     spec = P(bspec, None, sp_axis, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         lambda q_, k_, v_: _ring_body(q_, k_, v_, sp_axis, num_blocks, causal),
         mesh=mesh,
         in_specs=(spec, spec, spec),

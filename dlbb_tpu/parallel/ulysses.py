@@ -17,6 +17,7 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dlbb_tpu.compat import shard_map
 from dlbb_tpu.models.attention import dense_attention as _dense_attention
 
 
@@ -66,7 +67,7 @@ def ulysses_attention(
         # head-sharded -> seq-sharded
         return lax.all_to_all(oh, sp_axis, split_axis=2, concat_axis=1, tiled=True)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )
     return fn(q, k, v)

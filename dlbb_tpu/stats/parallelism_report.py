@@ -205,7 +205,12 @@ def collect_cp_scaling_rows(results_dir: Path) -> list[dict[str, Any]]:
         both = measured(ring) and measured(uly)
         winner = None
         if both:
-            winner = "ring" if ring >= uly else "ulysses"
+            # exact ties get an explicit marker instead of silently
+            # crediting ring (the >= would otherwise label them ring wins)
+            if ring == uly:
+                winner = "tie"
+            else:
+                winner = "ring" if ring > uly else "ulysses"
         elif measured(ring):
             winner = "ring (ulysses capped)"
         elif measured(uly):

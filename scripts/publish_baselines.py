@@ -850,7 +850,7 @@ def stage_stats() -> None:
     process_3d_results(RESULTS / "3d" / "xla_tpu", STATS / "3d" / "xla_tpu",
                        implementation="xla_tpu", verbose=False)
     log("stats: variants")
-    for name in {*EXECUTABLE_VARIANTS, *VARIANTS_16}:
+    for name in sorted({*EXECUTABLE_VARIANTS, *VARIANTS_16}):
         impl = _impl(name)
         in_dir = RESULTS / "variants" / impl
         if in_dir.exists():
@@ -860,7 +860,7 @@ def stage_stats() -> None:
     # every variant with 3D rows: the two full-grid winners, the whole
     # executable matrix from the tuning-grid stage, and the
     # 16-rank-shaped variants from its 16-rank rung
-    for name in {*VARIANTS_3D, *EXECUTABLE_VARIANTS, *VARIANTS_16}:
+    for name in sorted({*VARIANTS_3D, *EXECUTABLE_VARIANTS, *VARIANTS_16}):
         impl = _impl(name)
         in_dir = RESULTS / "variants3d" / impl
         if in_dir.exists():

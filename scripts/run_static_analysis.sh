@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# comm-lint CI gate: both static passes, no TPU needed.
+#
+#   scripts/run_static_analysis.sh [report.json]
+#
+# Runs the AST source lint over dlbb_tpu/ + scripts/ and the HLO collective
+# audit on an 8-device CPU-simulated mesh (the same surface as
+# `python -m dlbb_tpu.cli analyze all --simulate 8`), then the fast tier-1
+# analyzer tests.  Exit nonzero on any finding or test failure.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+REPORT="${1:-results/analysis/comm_lint.json}"
+
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze all --simulate 8 \
+    --strict-warnings --json "$REPORT"
+
+JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
+    -p no:cacheprovider
+
+echo "comm-lint: clean (report: $REPORT)"

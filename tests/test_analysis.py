@@ -1,0 +1,459 @@
+"""comm-lint tests.
+
+Seeded-violation fixtures: each deliberately broken computation / source
+snippet must produce exactly the expected finding, and its fixed twin must
+pass clean.  Plus the standing guarantees: every ``comm/ops.py`` registry
+collective audits clean, and the repo itself lints clean (the tier-1 gate
+behind ``scripts/run_static_analysis.sh``).
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from dlbb_tpu.analysis.expectations import TargetExpectation
+from dlbb_tpu.analysis.findings import AnalysisReport
+from dlbb_tpu.analysis.hlo_audit import (
+    AuditTarget,
+    audit_target,
+    registry_op_targets,
+    run_hlo_audit,
+)
+from dlbb_tpu.analysis.source_lint import lint_source, run_source_lint
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+
+
+# ---------------------------------------------------------------------------
+# HLO auditor: seeded violations
+# ---------------------------------------------------------------------------
+
+
+def _missharded_matmul_target(mesh8):
+    """A benchmark claiming 'row-parallel matmul, all-reduce only' whose
+    output sharding forces GSPMD to insert a hidden all-gather."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build():
+        xs = jax.device_put(
+            jnp.ones((64, 16), jnp.float32),
+            NamedSharding(mesh8, P("ranks", None)),
+        )
+        w = jax.device_put(
+            jnp.ones((16, 32), jnp.float32),
+            NamedSharding(mesh8, P(None, None)),
+        )
+        fn = jax.jit(
+            lambda a, b: a @ b,
+            out_shardings=NamedSharding(mesh8, P(None, None)),
+        )
+        return fn, (xs, w)
+
+    return AuditTarget(
+        name="fixture/missharded_matmul",
+        build=build,
+        expectation=TargetExpectation(
+            allowed={"all-reduce"}, required_any=None,
+        ),
+        min_devices=8,
+    )
+
+
+def test_missharded_matmul_yields_unexpected_allgather(mesh8):
+    findings, meta = audit_target(_missharded_matmul_target(mesh8))
+    assert len(findings) == 1, [f.to_dict() for f in findings]
+    f = findings[0]
+    assert f.rule == "unexpected-collective"
+    assert f.severity == "error"
+    assert f.details["kind"] == "all-gather"
+    # acceptance contract: op kind, shape, byte volume, replica groups,
+    # and the plan-derived expected volume all present and serializable
+    assert f.details["shape"] == [64, 32]
+    assert f.details["result_bytes"] == 64 * 32 * 4
+    assert f.details["replica_groups"]
+    assert f.details["analytic_wire_bytes"] > 0
+    assert f.details["expected_allowed_kinds"] == ["all-reduce"]
+    json.dumps(f.to_dict())  # must be JSON-serializable as-is
+
+
+def test_well_sharded_matmul_is_clean(mesh8):
+    """The same matmul with the output left row-sharded needs no
+    communication at all."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build():
+        xs = jax.device_put(
+            jnp.ones((64, 16), jnp.float32),
+            NamedSharding(mesh8, P("ranks", None)),
+        )
+        w = jax.device_put(
+            jnp.ones((16, 32), jnp.float32),
+            NamedSharding(mesh8, P(None, None)),
+        )
+        fn = jax.jit(
+            lambda a, b: a @ b,
+            out_shardings=NamedSharding(mesh8, P("ranks", None)),
+        )
+        return fn, (xs, w)
+
+    findings, _ = audit_target(AuditTarget(
+        name="fixture/row_parallel_matmul",
+        build=build,
+        expectation=TargetExpectation(allowed=set(), required_any=None),
+        min_devices=8,
+    ))
+    assert findings == []
+
+
+def _donation_target(mesh8, donate: bool):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def build():
+        kwargs = {"donate_argnums": (0,)} if donate else {}
+        fn = jax.jit(lambda s, x: (s + x, jnp.sum(x)), **kwargs)
+        sharding = NamedSharding(mesh8, P("ranks", None))
+        s = jax.device_put(jnp.zeros((8, 16), jnp.float32), sharding)
+        x = jax.device_put(jnp.ones((8, 16), jnp.float32), sharding)
+        return fn, (s, x)
+
+    return AuditTarget(
+        name=f"fixture/step_donate_{donate}",
+        build=build,
+        expectation=TargetExpectation(
+            allowed={"all-reduce"}, required_any=None,
+            expect_donation=True,
+        ),
+        min_devices=8,
+    )
+
+
+def test_undonated_step_yields_missing_donation(mesh8):
+    findings, _ = audit_target(_donation_target(mesh8, donate=False))
+    assert [f.rule for f in findings] == ["missing-donation"]
+
+
+def test_donated_step_is_clean(mesh8):
+    findings, _ = audit_target(_donation_target(mesh8, donate=True))
+    assert findings == []
+
+
+def test_registry_ops_audit_clean(devices):
+    """Every comm/ops.py registry collective lowers to exactly the HLO
+    collective its expectation table claims — the clean-pass guarantee the
+    sweeps rely on."""
+    report = run_hlo_audit(targets=registry_op_targets())
+    assert report.findings == [], [f.render() for f in report.findings]
+    assert len(report.targets_audited) >= 10
+    assert report.skipped_targets == []
+
+
+def test_barrier_audits_clean(devices):
+    """The timing barrier must lower to a scalar-sized all-reduce and
+    nothing else (it synchronises; it must not move data)."""
+    from dlbb_tpu.analysis.hlo_audit import _barrier_target
+
+    findings, meta = audit_target(_barrier_target())
+    assert findings == [], [f.render() for f in findings]
+    assert meta["num_collectives"] >= 1
+
+
+def test_parse_async_start_payload_is_kind_aware():
+    """Async ``-start`` tuples hold (operand, result, ...); the payload is
+    the result — the smallest element for reduce-scatter (it shrinks by the
+    group size), the largest for all-gather (it grows)."""
+    from dlbb_tpu.analysis.hlo_parse import parse_collectives
+
+    rs = ("  %rs = (f32[64]{0}, f32[8]{0}) reduce-scatter-start("
+          "f32[64]{0} %p), channel_id=1, "
+          "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}")
+    (instr,) = parse_collectives(rs)
+    assert instr.kind == "reduce-scatter"
+    assert instr.result_bytes == 32 and instr.shape == (8,)
+
+    ag = ("  %ag = (f32[8]{0}, f32[64]{0}) all-gather-start("
+          "f32[8]{0} %p), channel_id=1, "
+          "replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}")
+    (instr,) = parse_collectives(ag)
+    assert instr.kind == "all-gather"
+    assert instr.result_bytes == 256 and instr.shape == (64,)
+
+
+def test_audit_skips_targets_needing_more_devices(devices):
+    report = run_hlo_audit(targets=[AuditTarget(
+        name="fixture/needs_1024_devices",
+        build=lambda: (_ for _ in ()).throw(AssertionError("not built")),
+        expectation=TargetExpectation(),
+        min_devices=1024,
+    )])
+    assert report.targets_audited == []
+    assert len(report.skipped_targets) == 1
+
+
+# ---------------------------------------------------------------------------
+# source lint: seeded violations
+# ---------------------------------------------------------------------------
+
+
+HOST_SYNC_TIMER_FIXTURE = textwrap.dedent("""
+    import jax
+    from dlbb_tpu.utils.metrics import Timer
+
+    def bench(fn, x):
+        with Timer() as t:
+            y = fn(x)
+            jax.block_until_ready(y)
+            z = fn(y)
+        return t.elapsed, z
+""")
+
+
+def test_lint_host_sync_in_timer_block():
+    findings, _ = lint_source(HOST_SYNC_TIMER_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["host-sync-in-timed-region"]
+    assert findings[0].location == "fixture.py:8"
+
+
+def test_lint_final_bracketing_sync_allowed():
+    src = HOST_SYNC_TIMER_FIXTURE.replace("        z = fn(y)\n", "")
+    src = src.replace("return t.elapsed, z", "return t.elapsed, y")
+    findings, _ = lint_source(src, "fixture.py")
+    assert findings == []
+
+
+PERF_COUNTER_FIXTURE = textwrap.dedent("""
+    import time
+    import numpy as np
+
+    def bench(fn, x):
+        t0 = time.perf_counter()
+        y = fn(x)
+        host = np.asarray(y)
+        y = fn(y)
+        elapsed = time.perf_counter() - t0
+        return elapsed, host
+""")
+
+
+def test_lint_host_sync_in_perf_counter_region():
+    findings, _ = lint_source(PERF_COUNTER_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["host-sync-in-timed-region"]
+    assert "np.asarray" in findings[0].message
+
+
+def test_lint_suppression_comment():
+    src = HOST_SYNC_TIMER_FIXTURE.replace(
+        "jax.block_until_ready(y)",
+        "jax.block_until_ready(y)  "
+        "# comm-lint: disable=host-sync-in-timed-region",
+    )
+    findings, suppressed = lint_source(src, "fixture.py")
+    assert findings == []
+    assert suppressed == 1
+
+
+def test_lint_file_level_suppression():
+    src = ("# comm-lint: disable-file=host-sync-in-timed-region\n"
+           + HOST_SYNC_TIMER_FIXTURE)
+    findings, suppressed = lint_source(src, "fixture.py")
+    assert findings == []
+    assert suppressed == 1
+
+
+DONATION_FIXTURE = textwrap.dedent("""
+    import jax
+
+    def make_step(optimizer):
+        def train_step(state, batch):
+            return state, batch
+
+        return jax.jit(train_step)
+""")
+
+
+def test_lint_missing_donation_on_train_step_jit():
+    findings, _ = lint_source(DONATION_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["missing-donation"]
+    fixed = DONATION_FIXTURE.replace(
+        "jax.jit(train_step)", "jax.jit(train_step, donate_argnums=(0,))"
+    )
+    assert lint_source(fixed, "fixture.py")[0] == []
+
+
+JIT_IN_LOOP_FIXTURE = textwrap.dedent("""
+    import jax
+
+    def sweep(xs, scales):
+        outs = []
+        for s in scales:
+            f = jax.jit(lambda x: x * s)
+            outs.append(f(xs))
+        return outs
+""")
+
+
+def test_lint_jit_in_loop_scalar_capture():
+    findings, _ = lint_source(JIT_IN_LOOP_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["jit-in-loop"]
+    hoisted = textwrap.dedent("""
+        import jax
+
+        def sweep(xs, scales):
+            f = jax.jit(lambda x, s: x * s)
+            outs = []
+            for s in scales:
+                outs.append(f(xs, s))
+            return outs
+    """)
+    assert lint_source(hoisted, "fixture.py")[0] == []
+
+
+def test_lint_jit_in_loop_def():
+    """An in-loop ``def`` closing over the loop variable is the same fresh
+    trace + compile hazard as an inline lambda."""
+    src = textwrap.dedent("""
+        import jax
+
+        def sweep(xs, scales):
+            outs = []
+            for s in scales:
+                def f(x):
+                    return x * s
+                outs.append(jax.jit(f)(xs))
+            return outs
+    """)
+    findings, _ = lint_source(src, "fixture.py")
+    assert [f.rule for f in findings] == ["jit-in-loop"]
+    assert findings[0].severity == "warning"
+    hoisted = textwrap.dedent("""
+        import jax
+
+        def sweep(xs, scales):
+            def f(x, s):
+                return x * s
+            g = jax.jit(f)
+            return [g(xs, s) for s in scales]
+    """)
+    assert lint_source(hoisted, "fixture.py")[0] == []
+
+
+def test_lint_host_sync_in_finally_block():
+    """perf_counter regions inside a ``finally:`` block are linted too."""
+    src = textwrap.dedent("""
+        import time
+        import numpy as np
+
+        def bench(fn, x):
+            try:
+                y = None
+            finally:
+                t0 = time.perf_counter()
+                y = fn(x)
+                host = np.asarray(y)
+                y = fn(y)
+                elapsed = time.perf_counter() - t0
+            return elapsed, host
+    """)
+    findings, _ = lint_source(src, "fixture.py")
+    assert [f.rule for f in findings] == ["host-sync-in-timed-region"]
+
+
+SET_ITER_FIXTURE = textwrap.dedent("""
+    NAMES_A = ("b", "a")
+    NAMES_B = ("c",)
+
+    def publish():
+        for name in {*NAMES_A, *NAMES_B}:
+            print(name)
+""")
+
+
+def test_lint_unsorted_set_iteration():
+    findings, _ = lint_source(SET_ITER_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["unsorted-set-iteration"]
+    fixed = SET_ITER_FIXTURE.replace(
+        "{*NAMES_A, *NAMES_B}", "sorted({*NAMES_A, *NAMES_B})"
+    )
+    assert lint_source(fixed, "fixture.py")[0] == []
+
+
+# ---------------------------------------------------------------------------
+# standing guarantees + report plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_repo_lints_clean():
+    """The repo's own sources must pass the lint rules (fast, pure AST —
+    the tier-1 gate run by scripts/run_static_analysis.sh)."""
+    report = run_source_lint(root=REPO_ROOT)
+    assert report.errors == [], [f.render() for f in report.errors]
+    assert report.files_linted > 40
+
+
+def test_report_json_roundtrip(tmp_path, mesh8):
+    findings, _ = audit_target(_missharded_matmul_target(mesh8))
+    report = AnalysisReport(findings=findings,
+                            targets_audited=["fixture/missharded_matmul"])
+    out = tmp_path / "report.json"
+    report.write_json(out)
+    data = json.loads(out.read_text())
+    assert data["summary"]["errors"] == 1
+    f = data["findings"][0]
+    assert f["rule"] == "unexpected-collective"
+    for key in ("kind", "shape", "result_bytes", "replica_groups",
+                "analytic_wire_bytes", "expected_allowed_kinds"):
+        assert key in f["details"], key
+
+
+def test_cli_analyze_lint_exits_zero():
+    from dlbb_tpu.analysis import run_analysis
+
+    assert run_analysis(which="lint", root=str(REPO_ROOT),
+                        verbose=False) == 0
+
+
+# ---------------------------------------------------------------------------
+# fail-closed: vacuous runs must not read as clean gates
+# ---------------------------------------------------------------------------
+
+
+def test_lint_wrong_root_is_an_error(tmp_path):
+    """A typo'd --root (no dlbb_tpu/ or scripts/ underneath) must fail, not
+    print '0 findings over 0 files' and exit 0."""
+    report = run_source_lint(root=str(tmp_path))
+    assert [f.rule for f in report.errors] == ["no-files-linted"]
+    assert report.files_linted == 0
+
+
+def test_hlo_all_targets_skipped_is_an_error(monkeypatch):
+    """When every audit target is skipped for lack of devices, the CLI exit
+    code must be nonzero — CI wired to it must not vacuously pass."""
+    from dlbb_tpu import analysis
+
+    starved = AuditTarget(
+        name="fixture/needs_1024_devices",
+        build=lambda: (_ for _ in ()).throw(AssertionError("not built")),
+        expectation=TargetExpectation(),
+        min_devices=1024,
+    )
+    monkeypatch.setattr(
+        "dlbb_tpu.analysis.hlo_audit.default_targets", lambda: [starved])
+    assert analysis.run_analysis(which="hlo", verbose=False) == 1
+
+
+def test_audit_crash_is_contained(devices):
+    """One target whose build raises must become an audit-crash finding,
+    not abort the audit of the remaining targets."""
+    boom = AuditTarget(
+        name="fixture/raises_on_build",
+        build=lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+        expectation=TargetExpectation(),
+        min_devices=1,
+    )
+    report = run_hlo_audit(targets=[boom, *registry_op_targets()])
+    crash = [f for f in report.findings if f.rule == "audit-crash"]
+    assert len(crash) == 1 and "boom" in crash[0].message
+    assert len(report.targets_audited) >= 10  # the rest still audited

@@ -9,8 +9,10 @@ results/ → stats/ flow of the reference.
 import json
 
 import numpy as np
+import pytest
 
 from dlbb_tpu.bench import Sweep1D, Sweep3D, run_sweep
+from dlbb_tpu.compat import supports_compiler_option
 from dlbb_tpu.stats import process_1d_results, process_3d_results
 
 
@@ -104,7 +106,14 @@ def test_sweep_1d_time_budget_clamps_iterations(tmp_path, devices):
 
 def test_sweep_1d_nofuse_variant(tmp_path, devices):
     """The fusion-off variant (combiner HLO passes disabled via
-    per-computation compiler options) executes and is labeled."""
+    per-computation compiler options) executes and is labeled.  On jaxlibs
+    whose compile path rejects repeated DebugOptions fields the variant is
+    unsupported (run_sweep refuses up-front, see test below) and this
+    skips."""
+    if not supports_compiler_option("xla_disable_hlo_passes",
+                                    "all-reduce-combiner"):
+        pytest.skip("per-computation xla_disable_hlo_passes unsupported "
+                    "on this jaxlib (repeated DebugOptions field)")
     sweep = _tiny_1d(
         tmp_path, variant="nofuse", operations=("allreduce",),
         data_sizes=(("1KB", 256),), rank_counts=(8,),
@@ -113,6 +122,21 @@ def test_sweep_1d_nofuse_variant(tmp_path, devices):
     assert len(files) == 1
     data = json.loads(files[0].read_text())
     assert data["implementation"] == "xla_test_nofuse"
+
+
+def test_sweep_refuses_unsupported_compiler_options(tmp_path, devices):
+    """Where per-computation compiler options cannot be applied, the sweep
+    must refuse to run rather than silently mislabel results (same
+    convention as unset variant XLA_FLAGS)."""
+    if supports_compiler_option("xla_disable_hlo_passes",
+                                "all-reduce-combiner"):
+        pytest.skip("this jaxlib supports the option; nothing to refuse")
+    sweep = _tiny_1d(
+        tmp_path, variant="nofuse", operations=("allreduce",),
+        data_sizes=(("1KB", 256),), rank_counts=(8,),
+    )
+    with pytest.raises(RuntimeError, match="compiler"):
+        run_sweep(sweep, verbose=False)
 
 
 def test_variant_axis_order_meshes():

@@ -173,8 +173,9 @@ def test_flash_kv_cache_decode():
 
 
 def test_flash_tp_shard_map_matches_unsharded(mesh2x4):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from dlbb_tpu.compat import shard_map
 
     q, k, v = _qkv(jax.random.key(6), 2, 4, 128, 64, jnp.float32)
     spec = P("dp", "tp", None, None)
