@@ -71,7 +71,10 @@ def bench_allreduce_multichip(
         "metric": f"1d_allreduce_{label}_bus_bandwidth_{n}ranks",
         "value": round(bw, 3),
         "unit": "GB/s",
-        "vs_baseline": round(bw / ONECCL_BASELINE_GBPS, 3),
+        # from the PUBLISHED (rounded) value, so the artifact is
+        # self-consistent: a consumer recomputing value/baseline must get
+        # this number even when the raw bw sits on a rounding boundary
+        "vs_baseline": round(round(bw, 3) / ONECCL_BASELINE_GBPS, 3),
         "timing_mode": meta["timing_mode"],
         "timing_granularity": meta.get("timing_granularity",
                                        "per_iteration"),
@@ -129,7 +132,9 @@ def bench_e2e_single_chip() -> dict:
         "metric": "e2e_1B_forward_throughput_vs_reference_cpu_stack",
         "value": round(tps, 1),
         "unit": "tokens/s",
-        "vs_baseline": round(tps / baseline["tokens_per_second"], 3),
+        # published-value consistency, as in bench_allreduce_multichip
+        "vs_baseline": round(
+            round(tps, 1) / baseline["tokens_per_second"], 3),
     }
     # secondary lines: the flagship 7B config and the real-attention 1B
     # paths at the reference's S=512, plus a full-vs-dense pair at S=1024

@@ -23,6 +23,9 @@ filenames match ``collectives/1d/openmpi.py:273-295`` and
 
 from __future__ import annotations
 
+import contextlib
+import os
+import threading
 import time
 import traceback
 from dataclasses import dataclass
@@ -33,16 +36,18 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dlbb_tpu.comm.mesh import build_mesh
+from dlbb_tpu.bench import schedule
+from dlbb_tpu.comm.mesh import get_mesh
 from dlbb_tpu.comm.ops import (
     build_allreduce_hierarchical,
     get_op,
     make_payload,
+    payload_cache_key,
 )
 from dlbb_tpu.comm.variants import Variant, get_variant
 from dlbb_tpu.utils.config import save_json
 from dlbb_tpu.utils.sysinfo import collect_system_info
-from dlbb_tpu.utils.timing import time_collective
+from dlbb_tpu.utils.timing import resolve_timing_mode, time_collective
 
 # Reference 1D sweep constants (``collectives/1d/openmpi.py:14-49``).
 # NOTE the reference's size labels are 2x the actual fp16 payload
@@ -115,6 +120,17 @@ class Sweep1D:
     # interrupted sweep (time-budgeted publisher runs) pick up where it left
     # off instead of re-measuring the whole grid
     resume: bool = False
+    # pipelined execution engine (dlbb_tpu.bench.schedule): compile config
+    # N+1..N+prefetch on a background thread between measurements.
+    # None = auto (schedule.default_pipeline: only on hosts with spare
+    # cores); False = serial debug mode (--no-pipeline), identical
+    # schema/semantics; True forces the thread on
+    pipeline: Optional[bool] = None
+    prefetch: int = 2
+    # persistent XLA compilation cache: "auto" -> results/.xla_cache, an
+    # explicit directory, or None/"off" to disable (DLBB_XLA_CACHE env
+    # overrides either way)
+    compile_cache: Optional[str] = "auto"
 
     kind: str = "1d"
 
@@ -139,6 +155,10 @@ class Sweep3D:
     max_config_seconds: Optional[float] = None
     max_global_bytes: Optional[int] = None
     resume: bool = False
+    # pipelined execution engine — see Sweep1D (None = host-auto)
+    pipeline: Optional[bool] = None
+    prefetch: int = 2
+    compile_cache: Optional[str] = "auto"
 
     kind: str = "3d"
 
@@ -206,10 +226,75 @@ def _check_variant_flags(variant: Variant) -> None:
         )
 
 
+_NULL_GATE = contextlib.nullcontext()
+
+
 def _build_fn(op_name: str, variant: Variant, mesh, axes, root: int):
     if op_name == "allreduce" and variant.hierarchical:
         return build_allreduce_hierarchical(mesh, axes, root)
     return get_op(op_name).build(mesh, axes, root)
+
+
+@dataclass
+class _Planned:
+    """One measurable sweep config, resolved at plan time."""
+
+    num_ranks: int
+    mesh: Any
+    axes: tuple[str, ...]
+    config: dict[str, Any]
+    unit: schedule.WorkUnit
+    payload_key: tuple
+    # derived once here; _run_one must build the payload the unit's
+    # executable was AOT-compiled against, never re-derive it
+    num_elements: int
+    payload_shape: Optional[tuple[int, ...]]
+
+
+def _payload_geometry(
+    sweep, config,
+) -> tuple[int, Optional[tuple[int, ...]]]:
+    """(num_elements, per-rank payload shape) of one config."""
+    if sweep.kind == "1d":
+        return config["num_elements"], None
+    shape = (config["batch"], config["seq_len"], config["hidden_dim"])
+    return int(np.prod(shape)), shape
+
+
+def _plan_config(
+    sweep, variant, mesh, axes, num_ranks, config,
+    units, mode,
+) -> _Planned:
+    """Resolve one config's payload identity and compile work unit."""
+    op = get_op(config["operation"])
+    dtype = _dtype_of(sweep.dtype)
+    num_elements, payload_shape = _payload_geometry(sweep, config)
+    unit = schedule.plan_collective_unit(
+        units,
+        op=op,
+        build_fn=lambda: _build_fn(
+            config["operation"], variant, mesh, axes, sweep.root
+        ),
+        variant_name=variant.name,
+        mesh=mesh,
+        axes=axes,
+        root=sweep.root,
+        num_ranks=num_ranks,
+        num_elements=num_elements,
+        dtype=dtype,
+        payload_shape=payload_shape,
+        mode=mode,
+        iterations=sweep.measurement_iterations,
+        compiler_options=(
+            dict(variant.compiler_options) if variant.compiler_options
+            else None
+        ),
+    )
+    pkey = payload_cache_key(
+        op, mesh, axes, num_elements, dtype=dtype, shape=payload_shape
+    )
+    return _Planned(num_ranks, mesh, axes, config, unit, pkey,
+                    num_elements, payload_shape)
 
 
 def run_sweep(
@@ -219,9 +304,19 @@ def run_sweep(
 ) -> list[Path]:
     """Run a full sweep, writing one reference-schema JSON per config.
 
-    Per-config failures are caught, reported, and skipped so one failing
-    combination doesn't kill the sweep (reference
-    ``collectives/1d/openmpi.py:253-267``).
+    The grid is walked twice: a *planning* pass resolves skips
+    (rank gates, memory caps, ``resume``) and interns each measurable
+    config's compile work unit — deduplicated by
+    :func:`dlbb_tpu.bench.schedule.work_unit_key` — then the *measurement*
+    pass consumes configs in plan order while a background thread compiles
+    up to ``sweep.prefetch`` units ahead (``sweep.pipeline=False`` compiles
+    inline through the same path).  Payloads and meshes are reused across
+    configs that share them; a ``sweep_manifest.json`` with wall/compile
+    totals lands next to the artifacts.
+
+    Per-config failures — compile failures included — are caught,
+    reported, and skipped so one failing combination doesn't kill the
+    sweep (reference ``collectives/1d/openmpi.py:253-267``).
     """
     variant = get_variant(sweep.variant)
     _check_variant_flags(variant)
@@ -230,9 +325,38 @@ def run_sweep(
     written: list[Path] = []
     sysinfo = collect_system_info()
     n_avail = len(devices) if devices is not None else len(jax.devices())
+    t_sweep0 = time.perf_counter()
+    mode = resolve_timing_mode(sweep.timing_mode)
 
+    # everything from here — planning included — runs with the persistent
+    # compilation cache scoped to this sweep; the finally guarantees no
+    # later non-sweep compile ever sees it (see
+    # schedule.deactivate_compilation_cache)
+    cache_dir = schedule.configure_compilation_cache(sweep.compile_cache)
+    try:
+        return _run_sweep_configured(
+            sweep, variant, impl, out_dir, written, sysinfo, n_avail,
+            devices, mode, cache_dir, t_sweep0, verbose,
+        )
+    finally:
+        schedule.deactivate_compilation_cache()
+
+
+def _run_sweep_configured(
+    sweep, variant, impl, out_dir, written, sysinfo, n_avail, devices,
+    mode, cache_dir, t_sweep0, verbose,
+) -> list[Path]:
+    # ---- planning pass -------------------------------------------------
+    plan: list[_Planned] = []
+    units: "dict[tuple, schedule.WorkUnit]" = {}
+    # every counter counts CONFIGS (a skipped rank count skips one whole
+    # grid of them), so planned+skipped+resumed+failed adds up
+    grid_size = sum(1 for _ in _iter_configs(sweep))
+    counts = {"resumed": 0, "skipped_mem": 0, "skipped_ranks": 0,
+              "measured": 0, "failed": 0}
     for num_ranks in sweep.rank_counts:
         if num_ranks > n_avail:
+            counts["skipped_ranks"] += grid_size
             if verbose:
                 print(
                     f"[skip] {num_ranks} ranks > {n_avail} devices available"
@@ -240,59 +364,148 @@ def run_sweep(
             continue
         try:
             spec = variant.mesh_spec(num_ranks)
-            mesh = build_mesh(spec, devices=devices)
+            mesh = get_mesh(spec, devices=devices)
         except ValueError as e:
             # e.g. fixed-shape variant (2x2x2) asked for an incompatible rank
             # count — skip this rank count, keep sweeping (parity with the
             # reference's per-config error-skip, collectives/1d/openmpi.py:253)
+            counts["skipped_ranks"] += grid_size
             if verbose:
                 print(f"[skip] ranks={num_ranks}: {e}")
             continue
         axes = spec.axis_names
         for config in _iter_configs(sweep):
-            if sweep.max_global_bytes is not None:
-                est = _estimate_global_bytes(sweep, config, num_ranks)
-                if est > sweep.max_global_bytes:
-                    if verbose:
-                        print(
-                            f"[skip-mem] {config['operation']} ranks="
-                            f"{num_ranks} {config}: ~{est / 2**30:.1f} GiB "
-                            f"> cap {sweep.max_global_bytes / 2**30:.1f} GiB"
-                        )
-                    continue
-            if sweep.resume:
-                existing = out_dir / _result_filename(
-                    sweep, impl, num_ranks, config
-                )
-                if _resume_exists(existing):
-                    if verbose:
-                        print(f"  [resume-skip] {existing.name}")
-                    written.append(existing)
-                    continue
+            # per-config containment covers the WHOLE planning of a config
+            # (mem estimate included — it resolves the op name too): e.g.
+            # an unknown op skips that config and keeps sweeping, exactly
+            # like a measurement-time failure
+            try:
+                if sweep.max_global_bytes is not None:
+                    est = _estimate_global_bytes(sweep, config, num_ranks)
+                    if est > sweep.max_global_bytes:
+                        counts["skipped_mem"] += 1
+                        if verbose:
+                            print(
+                                f"[skip-mem] {config['operation']} ranks="
+                                f"{num_ranks} {config}: ~{est / 2**30:.1f} "
+                                "GiB > cap "
+                                f"{sweep.max_global_bytes / 2**30:.1f} GiB"
+                            )
+                        continue
+                if sweep.resume:
+                    existing = out_dir / _result_filename(
+                        sweep, impl, num_ranks, config
+                    )
+                    if _resume_exists(existing):
+                        counts["resumed"] += 1
+                        if verbose:
+                            print(f"  [resume-skip] {existing.name}")
+                        written.append(existing)
+                        continue
+                plan.append(_plan_config(
+                    sweep, variant, mesh, axes, num_ranks, config, units,
+                    mode,
+                ))
+            except Exception as e:  # noqa: BLE001 — per-config containment
+                counts["failed"] += 1
+                if verbose:
+                    print(f"[error] {impl} {config}: planning failed: {e}")
+                continue
+
+    # ---- measurement pass, compile-ahead overlapped --------------------
+    # the gate keeps background compiles out of timed regions (see
+    # CompileAheadScheduler); DLBB_COMPILE_OVERLAP=1 lifts it on hosts
+    # with cores to spare
+    measure_gate = (
+        None if os.environ.get("DLBB_COMPILE_OVERLAP") == "1"
+        else threading.Lock()
+    )
+    pipeline = (sweep.pipeline if sweep.pipeline is not None
+                else schedule.default_pipeline())
+    scheduler = schedule.CompileAheadScheduler(
+        units.values(), prefetch=sweep.prefetch, pipeline=pipeline,
+        measure_gate=measure_gate,
+    )
+    payloads = schedule.PayloadCache()
+    scheduler.start()
+    try:
+        for entry in plan:
+            unit = scheduler.get(entry.unit)
+            if unit.error is not None:
+                counts["failed"] += 1
+                if verbose:
+                    print(f"[error] {impl} {entry.config}: compile failed "
+                          f"for {unit.label}: {unit.error}")
+                continue
             try:
                 path = _run_one(
-                    sweep, variant, impl, mesh, axes, num_ranks, config,
-                    out_dir, sysinfo, verbose,
+                    sweep, variant, impl, entry, out_dir, sysinfo, verbose,
+                    mode=mode, payloads=payloads, measure_gate=measure_gate,
                 )
                 written.append(path)
+                counts["measured"] += 1
             except Exception as e:  # noqa: BLE001 — sweep resilience
+                counts["failed"] += 1
                 if verbose:
-                    print(f"[error] {impl} {config}: {e}")
+                    print(f"[error] {impl} {entry.config}: {e}")
                     traceback.print_exc()
                 continue
+    finally:
+        scheduler.close()
+
+    if plan or counts["resumed"]:
+        unit_list = list(units.values())
+        compiled = [u for u in unit_list if u.ready.is_set() and not u.error]
+        schedule.write_sweep_manifest(out_dir, {
+            "kind": sweep.kind,
+            "implementation": impl,
+            "variant": variant.name,
+            "timing_mode": mode,
+            "pipeline": scheduler.pipelined,
+            "prefetch": sweep.prefetch,
+            "wall_seconds": time.perf_counter() - t_sweep0,
+            "compile_seconds_total": sum(
+                u.compile_seconds for u in unit_list
+            ),
+            "compile_cache": {
+                "dir": cache_dir,
+                "enabled": cache_dir is not None,
+                "persistent_hits": sum(
+                    1 for u in compiled if u.persistent_cache_hit
+                ),
+                "persistent_misses": sum(
+                    1 for u in compiled if not u.persistent_cache_hit
+                ),
+            },
+            "work_units": {
+                "planned_configs": len(plan),
+                "unique": len(unit_list),
+                "compile_failed": sum(
+                    1 for u in unit_list if u.error is not None
+                ),
+            },
+            "configs": dict(counts),
+            "payload_cache": payloads.stats(),
+            "timestamp": time.time(),
+        })
     return written
 
 
 def _estimate_global_bytes(sweep, config, num_ranks: int) -> int:
-    """Rough global input+output footprint of one config: per_peer inputs
-    and (all)gather/alltoall outputs scale with P^2 x payload."""
+    """Rough global input+output footprint of one config.
+
+    Both multipliers come from the op registry's declared buffer kinds
+    (``per_peer`` scales with P^2 x payload, ``per_rank`` with P) — not
+    from a hard-coded op-name list, so a newly registered collective is
+    estimated by its declaration instead of silently defaulting to the
+    per-rank multiplier.  ``tests/test_bench.py`` pins every registry op's
+    estimate."""
     op = get_op(config["operation"])
-    n = (config["num_elements"] if sweep.kind == "1d"
-         else config["batch"] * config["seq_len"] * config["hidden_dim"])
+    n = _payload_geometry(sweep, config)[0]
     itemsize = jnp.dtype(_dtype_of(sweep.dtype)).itemsize
     p = num_ranks
     in_mult = p * p if op.input_kind == "per_peer" else p
-    out_mult = p * p if op.name in ("allgather", "gather", "alltoall") else p
+    out_mult = p * p if op.output_kind == "per_peer" else p
     return (in_mult + out_mult) * n * itemsize
 
 
@@ -355,40 +568,70 @@ def _result_filename(sweep, impl: str, num_ranks: int, config) -> str:
 
 
 def _run_one(
-    sweep, variant, impl, mesh, axes, num_ranks, config, out_dir, sysinfo,
-    verbose,
+    sweep, variant, impl, planned: _Planned, out_dir, sysinfo, verbose,
+    *, mode: str, payloads: schedule.PayloadCache,
+    measure_gate: Optional[threading.Lock] = None,
 ) -> Path:
+    mesh, axes = planned.mesh, planned.axes
+    num_ranks, config, unit = planned.num_ranks, planned.config, planned.unit
     op_name = config["operation"]
     op = get_op(op_name)
     dtype = _dtype_of(sweep.dtype)
     elem_bytes = jnp.dtype(dtype).itemsize
+    # the plan-time geometry: what the unit's executable was compiled for
+    num_elements = planned.num_elements
+    payload_shape = planned.payload_shape
 
-    if sweep.kind == "1d":
-        num_elements = config["num_elements"]
-        payload_shape = None
-    else:
-        payload_shape = (config["batch"], config["seq_len"], config["hidden_dim"])
-        num_elements = int(np.prod(payload_shape))
+    def build_payload():
+        return make_payload(
+            op, mesh, axes, num_elements, dtype=dtype, shape=payload_shape
+        )
 
-    x = make_payload(
-        op, mesh, axes, num_elements, dtype=dtype, shape=payload_shape
-    )
-    fn = _build_fn(op_name, variant, mesh, axes, sweep.root)
+    # chained timing DONATES its carry, so a cached payload would come back
+    # deleted — only per-iter configs share payloads
+    x = (build_payload() if mode == "chained"
+         else payloads.get(planned.payload_key, build_payload))
+    fn = unit.fn
     chain = op.make_chain(num_ranks) if op.make_chain is not None else None
 
-    local, timing_meta = time_collective(
-        fn, x,
-        chain=chain,
-        warmup=sweep.warmup_iterations,
-        iterations=sweep.measurement_iterations,
-        mode=sweep.timing_mode,
-        max_seconds=sweep.max_config_seconds,
-        compiler_options=(
-            dict(variant.compiler_options) if variant.compiler_options
-            else None
-        ),
-    )
+    # holding the gate keeps the compile-ahead worker out of the timed
+    # region — background compilation contends for the host cores the
+    # measured program runs on (measurement-honesty invariant; see
+    # schedule.CompileAheadScheduler)
+    try:
+        with measure_gate if measure_gate is not None else _NULL_GATE:
+            local, timing_meta = time_collective(
+                fn, x,
+                chain=chain,
+                warmup=sweep.warmup_iterations,
+                iterations=sweep.measurement_iterations,
+                mode=mode,
+                max_seconds=sweep.max_config_seconds,
+                compiler_options=(
+                    dict(variant.compiler_options)
+                    if variant.compiler_options else None
+                ),
+                executable=None if unit.chained else unit.executable,
+                chained_loop=unit.executable if unit.chained else None,
+            )
+    except BaseException:
+        # a failure mid-measurement may have already donated the cached
+        # payload (the per-iter plausibility fallback) — drop the entry
+        # so no later config is handed a deleted array
+        payloads.invalidate(planned.payload_key)
+        raise
+    if timing_meta.get("timing_mode") == "chained" and mode != "chained":
+        # the per-iter plausibility fallback donated the (cached) payload
+        payloads.invalidate(planned.payload_key)
     timings = _gather_timings(local)
+
+    # the first config that WRITES an artifact reports the compile its
+    # work unit paid for (see WorkUnit.compile_reported); later sharers
+    # paid nothing (in-process dedup) and report a cache hit
+    first_consumer = not unit.compile_reported
+    compile_seconds = unit.compile_seconds if first_consumer else 0.0
+    compile_cache_hit = (unit.persistent_cache_hit if first_consumer
+                         else True)
 
     result: dict[str, Any] = {
         "implementation": impl,
@@ -399,6 +642,11 @@ def _run_one(
         "dtype": sweep.dtype,
         "warmup_iterations": sweep.warmup_iterations,
         "measurement_iterations": sweep.measurement_iterations,
+        # compile accounting (dlbb_tpu.bench.schedule): what THIS config
+        # paid — 0.0 with a hit when its program was already compiled
+        # (in-process work-unit dedup or the persistent XLA cache)
+        "compile_seconds": compile_seconds,
+        "compile_cache_hit": compile_cache_hit,
         **timing_meta,
         "timings": timings,
         "variant": variant.name,
@@ -422,7 +670,13 @@ def _run_one(
 
     fname = _result_filename(sweep, impl, num_ranks, config)
     path = save_json(result, out_dir / fname)
+    unit.compile_reported = True
     if verbose:
-        mean_ms = float(np.mean(timings)) * 1e3
-        print(f"  [{impl}] {fname}: mean {mean_ms:.3f} ms")
+        # the same median the stats pipeline publishes
+        # (stats1d.calculate_statistics: np.median over the flattened
+        # per-host matrix), labeled with the mode actually used — a mean
+        # over chained chunk means is not comparable to a per-iter mean
+        median_ms = float(np.median(np.asarray(timings))) * 1e3
+        print(f"  [{impl}] {fname}: median {median_ms:.3f} ms "
+              f"({timing_meta.get('timing_mode', mode)})")
     return path

@@ -31,6 +31,21 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--resume", action="store_true",
                    help="skip configs whose result JSON already exists in the "
                         "output dir (pick an interrupted sweep back up)")
+    p.add_argument("--no-pipeline", action="store_true",
+                   help="disable the compile-ahead thread and compile each "
+                        "config inline (serial debug mode; identical result "
+                        "schema and timing semantics)")
+    p.add_argument("--pipeline", action="store_true",
+                   help="force the compile-ahead thread on (default: auto — "
+                        "enabled only on hosts with spare cores)")
+    p.add_argument("--prefetch", type=int, default=2, metavar="K",
+                   help="configs compiled ahead of the one measuring "
+                        "(pipelined mode; default 2)")
+    p.add_argument("--compile-cache", default="auto", metavar="DIR|off",
+                   help="persistent XLA compilation cache directory "
+                        "('auto' = results/.xla_cache relative to the CWD, "
+                        "like every other default path here; 'off' "
+                        "disables; DLBB_XLA_CACHE env overrides)")
     _add_trace(p)
 
 
@@ -175,6 +190,15 @@ def main(argv: list[str] | None = None) -> int:
     return _dispatch(args)
 
 
+def _pipeline_arg(args):
+    """--no-pipeline > --pipeline > None (host-auto)."""
+    if args.no_pipeline:
+        return False
+    if args.pipeline:
+        return True
+    return None
+
+
 def _dispatch(args) -> int:
     if args.cmd == "bench1d":
         from dlbb_tpu.bench import (
@@ -207,6 +231,9 @@ def _dispatch(args) -> int:
             measurement_iterations=args.iters,
             output_dir=args.output or "results/1d",
             resume=args.resume,
+            pipeline=_pipeline_arg(args),
+            prefetch=args.prefetch,
+            compile_cache=args.compile_cache,
         )
         files = run_sweep(sweep)
         # resume mode counts pre-existing artifacts too — don't claim writes
@@ -229,6 +256,9 @@ def _dispatch(args) -> int:
             measurement_iterations=args.iters,
             output_dir=args.output or "results/3d",
             resume=args.resume,
+            pipeline=_pipeline_arg(args),
+            prefetch=args.prefetch,
+            compile_cache=args.compile_cache,
         )
         files = run_sweep(sweep)
         print(f"{len(files)} result artifacts in {sweep.output_dir}")

@@ -102,6 +102,35 @@ def build_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(grid, spec.axis_names)
 
 
+# (spec, device identity) -> Mesh.  jax.sharding.Mesh equality is cheap but
+# object identity matters downstream: jitted programs, NamedShardings, and
+# the sweep scheduler's work-unit/payload cache keys all want one Mesh per
+# topology per process, not a fresh object per run_sweep call.
+_MESH_CACHE: dict[tuple, Mesh] = {}
+
+
+def get_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
+    """``build_mesh`` with per-process memoisation.
+
+    Repeated sweeps over the same topology (the publisher's stage loops, a
+    resume re-run, the 1D/3D grids sharing a rank count) reuse one
+    ``Mesh`` object instead of rebuilding it per ``run_sweep`` call.  Keyed
+    by the spec and the identity of the devices that would populate it, so
+    an explicit ``devices`` subset never aliases the default-device mesh.
+    """
+    devs = list(devices) if devices is not None else available_devices()
+    key = (
+        spec.shape,
+        spec.axis_names,
+        tuple(id(d) for d in devs[: spec.num_ranks]),
+    )
+    mesh = _MESH_CACHE.get(key)
+    if mesh is None:
+        mesh = build_mesh(spec, devices=devs)
+        _MESH_CACHE[key] = mesh
+    return mesh
+
+
 def build_parallelism_mesh(
     data_parallel: int = 1,
     sequence_parallel: int = 1,

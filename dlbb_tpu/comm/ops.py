@@ -49,10 +49,15 @@ from dlbb_tpu.compat import axis_size, shard_map
 class CollectiveOp:
     """One benchmarkable collective.
 
-    input_kind:
+    input_kind / output_kind:
       "per_rank"  — global ``[P, n]``, device i owns row i (one buffer/rank)
       "per_peer"  — global ``[P, P, n]``, device i owns slab i (one buffer per
                     peer, as for MPI_Scatter's root sendbuf / MPI_Alltoall)
+
+    ``output_kind`` declares the op's *result* footprint the same way — e.g.
+    allgather turns a per-rank input into a per-peer ``[P, P, n]`` output —
+    so memory estimates (``runner._estimate_global_bytes``) derive their
+    multipliers from the registry instead of hard-coded op-name lists.
 
     make_chain(P) returns glue mapping the op's output back to a valid next
     input, used by chained timing (``dlbb_tpu.utils.timing``) to iterate the
@@ -62,8 +67,15 @@ class CollectiveOp:
 
     name: str
     input_kind: str
+    output_kind: str
     build: Callable[..., Callable]  # (mesh, axes, root) -> fn(global) -> global
     make_chain: Optional[Callable[[int], Callable]] = None
+
+
+# Payload RNG seed shared by make_payload and payload_cache_key: the cache
+# key's contract (equal keys => numerically identical arrays) requires the
+# two defaults to be THE SAME object, never two literals to keep in sync.
+DEFAULT_PAYLOAD_SEED = 42
 
 
 def _rank_id(axes: Sequence[str]) -> jax.Array:
@@ -280,25 +292,37 @@ def _chain_scatter_back(p: int):
 
 OPERATIONS: dict[str, CollectiveOp] = {
     "allreduce": CollectiveOp(
-        "allreduce", "per_rank", build_allreduce, _chain_rescale
+        "allreduce", "per_rank", "per_rank", build_allreduce, _chain_rescale
     ),
     "allgather": CollectiveOp(
-        "allgather", "per_rank", build_allgather, _chain_take_first
+        "allgather", "per_rank", "per_peer", build_allgather, _chain_take_first
     ),
-    "broadcast": CollectiveOp("broadcast", "per_rank", build_broadcast),
-    "gather": CollectiveOp("gather", "per_rank", build_gather, _chain_take_first),
+    "broadcast": CollectiveOp(
+        "broadcast", "per_rank", "per_rank", build_broadcast
+    ),
+    "gather": CollectiveOp(
+        "gather", "per_rank", "per_peer", build_gather, _chain_take_first
+    ),
     "scatter": CollectiveOp(
-        "scatter", "per_peer", build_scatter, _chain_rebroadcast
+        "scatter", "per_peer", "per_rank", build_scatter, _chain_rebroadcast
     ),
-    "reduce": CollectiveOp("reduce", "per_rank", build_reduce, _chain_rescale),
-    "alltoall": CollectiveOp("alltoall", "per_peer", build_alltoall),
-    "sendrecv": CollectiveOp("sendrecv", "per_rank", build_sendrecv),
+    "reduce": CollectiveOp(
+        "reduce", "per_rank", "per_rank", build_reduce, _chain_rescale
+    ),
+    "alltoall": CollectiveOp(
+        "alltoall", "per_peer", "per_peer", build_alltoall
+    ),
+    "sendrecv": CollectiveOp(
+        "sendrecv", "per_rank", "per_rank", build_sendrecv
+    ),
+    # reducescatter's [P, 1, n] output holds one reduced row per rank
     "reducescatter": CollectiveOp(
-        "reducescatter", "per_peer", build_reducescatter, _chain_scatter_back
+        "reducescatter", "per_peer", "per_rank", build_reducescatter,
+        _chain_scatter_back,
     ),
     "allreduce_hierarchical": CollectiveOp(
-        "allreduce_hierarchical", "per_rank", build_allreduce_hierarchical,
-        _chain_rescale,
+        "allreduce_hierarchical", "per_rank", "per_rank",
+        build_allreduce_hierarchical, _chain_rescale,
     ),
 }
 
@@ -312,13 +336,75 @@ def get_op(name: str) -> CollectiveOp:
         ) from None
 
 
+def payload_global_shape(
+    op: CollectiveOp,
+    mesh: Mesh,
+    axes: Sequence[str],
+    num_elements: int,
+    shape: Optional[tuple[int, ...]] = None,
+) -> tuple[int, ...]:
+    """Global array shape ``make_payload`` would build, without building it."""
+    num = mesh_num_ranks(mesh, axes)
+    per_rank_shape = tuple(shape) if shape is not None else (num_elements,)
+    if op.input_kind == "per_peer":
+        return (num, num) + per_rank_shape
+    return (num,) + per_rank_shape
+
+
+def payload_aval(
+    op: CollectiveOp,
+    mesh: Mesh,
+    axes: Sequence[str],
+    num_elements: int,
+    dtype=jnp.bfloat16,
+    shape: Optional[tuple[int, ...]] = None,
+) -> jax.ShapeDtypeStruct:
+    """Abstract (shape, dtype, sharding) of the op's payload — what AOT
+    lowering needs, so the compile-ahead scheduler
+    (``dlbb_tpu.bench.schedule``) can compile a config's program on a
+    background thread without materialising its (possibly GiB-scale)
+    payload first."""
+    global_shape = payload_global_shape(op, mesh, axes, num_elements, shape)
+    target = jax.dtypes.canonicalize_dtype(dtype)
+    sharding = NamedSharding(mesh, _specs(mesh, axes, len(global_shape)))
+    return jax.ShapeDtypeStruct(global_shape, target, sharding=sharding)
+
+
+def payload_cache_key(
+    op: CollectiveOp,
+    mesh: Mesh,
+    axes: Sequence[str],
+    num_elements: int,
+    dtype=jnp.bfloat16,
+    seed: int = DEFAULT_PAYLOAD_SEED,
+    shape: Optional[tuple[int, ...]] = None,
+) -> tuple:
+    """Hashable identity of a ``make_payload`` result: two calls with equal
+    keys return numerically identical, identically-sharded arrays, so sweep
+    configs that share (shape, dtype, sharding) — e.g. every per-rank op at
+    the same size label — can reuse one device payload instead of
+    regenerating it per config."""
+    global_shape = payload_global_shape(op, mesh, axes, num_elements, shape)
+    target = jax.dtypes.canonicalize_dtype(dtype)
+    return (
+        op.input_kind,
+        global_shape,
+        jnp.dtype(target).name,
+        seed,
+        tuple(mesh.devices.shape),
+        tuple(mesh.axis_names),
+        tuple(axes),
+        tuple(id(d) for d in mesh.devices.flat),
+    )
+
+
 def make_payload(
     op: CollectiveOp,
     mesh: Mesh,
     axes: Sequence[str],
     num_elements: int,
     dtype=jnp.bfloat16,
-    seed: int = 42,
+    seed: int = DEFAULT_PAYLOAD_SEED,
     shape: Optional[tuple[int, ...]] = None,
 ) -> jax.Array:
     """Build the global, mesh-sharded input for ``op``.

@@ -171,34 +171,28 @@ def time_fn_per_iter(
     return out, warmup_run, clamped
 
 
-def time_fn_chained(
+def chained_chunk_size(iterations: int, chunk_size: Optional[int] = None) -> int:
+    """The chunk size ``time_fn_chained`` will use for ``iterations``.
+
+    Factored out so AOT compilers of the chained loop (the sweep scheduler,
+    ``dlbb_tpu.bench.schedule``) bake in exactly the chunk size the
+    measurement will divide by — a mismatch would silently rescale every
+    sample."""
+    if chunk_size is not None:
+        return chunk_size
+    return max(1, min(10, iterations // 10 or 1))
+
+
+def build_chained_loop(
     op: Callable,
-    x: Any,
     chain: Optional[Callable] = None,
-    warmup: int = 1,
-    iterations: int = 100,
-    chunk_size: Optional[int] = None,
-    op_args: tuple = (),
-    compiler_options: Optional[dict[str, str]] = None,
-    max_seconds: Optional[float] = None,
-) -> tuple[list[float], dict[str, Any], Any]:
-    """Chunked fori_loop timing (remote-async backends).
-
-    ``op`` is invoked as ``op(*op_args, carry)``.  Anything large the op
-    needs (model params!) MUST go through ``op_args``, not a closure: arrays
-    closed over by the jitted loop are embedded as compile-time constants,
-    which at model scale stalls compilation indefinitely.
-
-    Returns ``(samples, meta, carry)``: each sample is the estimated
-    per-iteration time of one chunk, ``(chunk_wall - fetch_overhead) /
-    chunk_size``; ``len(samples) == iterations // chunk_size`` (≥ 1).
-    The input ``x`` is DONATED to the loop (see the comment at the jit
-    below) — callers must use the returned final ``carry`` instead of
-    ``x`` afterwards.
+    chunk_size: int = 10,
+) -> Callable:
+    """The jitted ``chunk_size``-iteration fori_loop around ``op`` that
+    chained timing measures — exposed so it can be AOT-lowered/compiled
+    ahead of the measurement (compile-ahead sweeps) with identical
+    semantics, donation included.
     """
-    if chunk_size is None:
-        chunk_size = max(1, min(10, iterations // 10 or 1))
-    chunks = max(1, iterations // chunk_size)
 
     def body(args, c):
         out = op(*args, c)
@@ -210,18 +204,57 @@ def time_fn_chained(
     # scale (TrainState = params + Adam moments) that doubles state HBM and
     # OOMs configs whose training loop itself fits (measured: 1B/b8/s512
     # Adam-bf16m trains, then OOMed in this timing loop before the fix)
-    looped = jax.jit(
+    return jax.jit(
         lambda args, x0: jax.lax.fori_loop(
             0, chunk_size, lambda i, c: body(args, c), x0
         ),
         donate_argnums=(1,),
     )
-    if compiler_options:
-        # variant-tuned compilation (e.g. combiner passes disabled) — the
-        # options must go on the outer loop jit, which subsumes the op
-        looped = looped.lower(op_args, x).compile(
-            compiler_options=dict(compiler_options)
-        )
+
+
+def time_fn_chained(
+    op: Callable,
+    x: Any,
+    chain: Optional[Callable] = None,
+    warmup: int = 1,
+    iterations: int = 100,
+    chunk_size: Optional[int] = None,
+    op_args: tuple = (),
+    compiler_options: Optional[dict[str, str]] = None,
+    max_seconds: Optional[float] = None,
+    looped: Optional[Callable] = None,
+) -> tuple[list[float], dict[str, Any], Any]:
+    """Chunked fori_loop timing (remote-async backends).
+
+    ``op`` is invoked as ``op(*op_args, carry)``.  Anything large the op
+    needs (model params!) MUST go through ``op_args``, not a closure: arrays
+    closed over by the jitted loop are embedded as compile-time constants,
+    which at model scale stalls compilation indefinitely.
+
+    ``looped`` short-circuits loop construction with a pre-built (possibly
+    pre-compiled) executable from :func:`build_chained_loop` — it MUST have
+    been built with this call's chunk size (:func:`chained_chunk_size`) and
+    ``compiler_options`` already applied.
+
+    Returns ``(samples, meta, carry)``: each sample is the estimated
+    per-iteration time of one chunk, ``(chunk_wall - fetch_overhead) /
+    chunk_size``; ``len(samples) == iterations // chunk_size`` (≥ 1).
+    The input ``x`` is DONATED to the loop (see the comment in
+    :func:`build_chained_loop`) — callers must use the returned final
+    ``carry`` instead of ``x`` afterwards.
+    """
+    chunk_size = chained_chunk_size(iterations, chunk_size)
+    chunks = max(1, iterations // chunk_size)
+
+    if looped is None:
+        looped = build_chained_loop(op, chain, chunk_size)
+        if compiler_options:
+            # variant-tuned compilation (e.g. combiner passes disabled) —
+            # the options must go on the outer loop jit, which subsumes
+            # the op
+            looped = looped.lower(op_args, x).compile(
+                compiler_options=dict(compiler_options)
+            )
 
     warm_wall = float("inf")
     for _ in range(max(1, warmup)):
@@ -279,6 +312,8 @@ def time_collective(
     mode: str = "auto",
     max_seconds: Optional[float] = None,
     compiler_options: Optional[dict[str, str]] = None,
+    executable: Optional[Callable] = None,
+    chained_loop: Optional[Callable] = None,
 ) -> tuple[list[float], dict[str, Any]]:
     """Unified entry: returns (per-iteration timings, metadata).
 
@@ -292,16 +327,31 @@ def time_collective(
     counts land in the metadata, overriding the sweep's nominal ones in the
     result JSON.  ``compiler_options`` compiles the op (or the chained loop
     around it) with variant-specific XLA options.
+
+    Compile-ahead callers (``dlbb_tpu.bench.schedule``) pass what they
+    already compiled: ``executable`` replaces ``op`` for per-iter timing
+    (it must be the same program, ``compiler_options`` included), and
+    ``chained_loop`` replaces the loop construction in chained mode (built
+    via :func:`build_chained_loop` with :func:`chained_chunk_size` of this
+    call's ``iterations``).  The traceable ``op`` is still required: the
+    per-iter implausibility fallback below re-traces it inside a fresh
+    loop, which a compiled executable cannot survive.  Timing semantics
+    are unchanged either way — warmup absorbed compilation before, and
+    with a pre-compiled program the same warmup calls simply find nothing
+    left to absorb.
     """
     mode = resolve_timing_mode(mode)
     if mode == "per_iter":
-        op_exec = op
-        if compiler_options and hasattr(op, "lower"):
-            # keep the traceable `op` around: the chained fallback below
-            # jit-traces it, which a Compiled cannot survive
-            op_exec = op.lower(x).compile(
-                compiler_options=dict(compiler_options)
-            )
+        if executable is not None:
+            op_exec = executable
+        else:
+            op_exec = op
+            if compiler_options and hasattr(op, "lower"):
+                # keep the traceable `op` around: the chained fallback below
+                # jit-traces it, which a Compiled cannot survive
+                op_exec = op.lower(x).compile(
+                    compiler_options=dict(compiler_options)
+                )
         timings, warmup_run, clamped = time_fn_per_iter(
             op_exec, x, warmup=warmup, iterations=iterations,
             max_seconds=max_seconds,
@@ -341,7 +391,7 @@ def time_collective(
                 samples, cmeta, _ = time_fn_chained(
                     op, x, chain=chain, warmup=1, iterations=iterations,
                     compiler_options=compiler_options,
-                    max_seconds=max_seconds,
+                    max_seconds=max_seconds, looped=chained_loop,
                 )
                 cmeta.update(
                     per_iter_sanity_failed=True,
@@ -361,6 +411,6 @@ def time_collective(
     samples, cmeta, _ = time_fn_chained(
         op, x, chain=chain, warmup=max(1, warmup // 10),
         iterations=iterations, compiler_options=compiler_options,
-        max_seconds=max_seconds,
+        max_seconds=max_seconds, looped=chained_loop,
     )
     return samples, cmeta

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Reproducible before/after evidence for the pipelined sweep engine.
+
+Runs the same fixed mini-grid (2 ops x 2 sizes x 2 rank counts on the
+8-device CPU-simulated mesh) through four engine settings — serial vs
+pipelined, each cold-cache then warm-cache — and writes the wall-clock /
+compile-time comparison to ``BENCH_sweep.json`` at the repo root.  The
+perf claim the artifact pins: warm-cache sweeps (either mode) finish in
+measurably less wall time than the cold serial sweep, while the measured
+medians stay statistically equivalent across modes.
+
+Usage: python scripts/bench_sweep_engine.py [--iters N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
+
+force_cpu_simulation(8)
+
+from dlbb_tpu.bench.runner import Sweep1D, run_sweep  # noqa: E402
+from dlbb_tpu.bench.schedule import MANIFEST_NAME  # noqa: E402
+
+# The fixed micro-grid: 2 ops x 2 sizes x 2 rank counts.  Small payloads
+# on purpose: the engine's win is COMPILE amortisation, so the harness
+# keeps per-config measurement cost small relative to per-config compile
+# cost — the regime the full publisher grids (~100 configs, most of them
+# sub-second to measure on this host, each paying a fresh trace+compile
+# on a --fresh re-run) actually live in.  At GiB labels measurement
+# dominates wall time and any compile win drowns (measured: ~0.3s
+# compile in a ~12s sweep on the 16MB grid).
+GRID = dict(
+    operations=("allreduce", "allgather"),
+    data_sizes=(("1KB", 256), ("64KB", 16384)),
+    rank_counts=(2, 4),
+)
+
+
+def _one_run(name: str, work: Path, cache: Path, pipeline: bool,
+             iters: int) -> dict:
+    out = work / name
+    sweep = Sweep1D(
+        implementation="bench_sweep",
+        dtype="float32",
+        warmup_iterations=2,
+        measurement_iterations=iters,
+        output_dir=str(out),
+        compile_cache=str(cache),
+        pipeline=pipeline,
+        **GRID,
+    )
+    t0 = time.perf_counter()
+    files = run_sweep(sweep, verbose=False)
+    wall = time.perf_counter() - t0
+    manifest = json.loads((out / MANIFEST_NAME).read_text())
+    medians = {}
+    for f in files:
+        d = json.loads(Path(f).read_text())
+        flat = [t for row in d["timings"] for t in row]
+        flat.sort()
+        key = f"{d['operation']}_r{d['num_ranks']}_{d['data_size_name']}"
+        medians[key] = flat[len(flat) // 2]
+    return {
+        "pipeline": pipeline,
+        "wall_seconds": round(wall, 4),
+        "compile_seconds_total": round(
+            manifest["compile_seconds_total"], 4),
+        "persistent_cache_hits":
+            manifest["compile_cache"]["persistent_hits"],
+        "persistent_cache_misses":
+            manifest["compile_cache"]["persistent_misses"],
+        "payload_cache_hits": manifest["payload_cache"]["hits"],
+        "artifacts": len(files),
+        "median_seconds_per_config": medians,
+    }
+
+
+def _median(vals):
+    vals = sorted(vals)
+    return vals[len(vals) // 2]
+
+
+def _aggregate(reps: list[dict]) -> dict:
+    """Per-setting aggregate over interleaved repetitions: median wall
+    (with min/max as the honest spread) and per-config medians of the
+    per-rep medians."""
+    walls = [r["wall_seconds"] for r in reps]
+    keys = reps[0]["median_seconds_per_config"]
+    return {
+        "pipeline": reps[0]["pipeline"],
+        "repetitions": len(reps),
+        "wall_seconds_median": round(_median(walls), 4),
+        "wall_seconds_min": round(min(walls), 4),
+        "wall_seconds_max": round(max(walls), 4),
+        "compile_seconds_total_median": round(_median(
+            [r["compile_seconds_total"] for r in reps]), 4),
+        "persistent_cache_hits": reps[-1]["persistent_cache_hits"],
+        "persistent_cache_misses": reps[-1]["persistent_cache_misses"],
+        "payload_cache_hits": reps[-1]["payload_cache_hits"],
+        "artifacts": reps[-1]["artifacts"],
+        "median_seconds_per_config": {
+            k: _median([r["median_seconds_per_config"][k] for r in reps])
+            for k in keys
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30,
+                    help="measured iterations per config (default 30)")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="interleaved repetitions per setting (default 3; "
+                         "run-to-run medians on an oversubscribed host "
+                         "swing several-fold, so single runs mislead)")
+    ap.add_argument("--output", default=str(REPO / "BENCH_sweep.json"))
+    args = ap.parse_args()
+
+    work = Path(tempfile.mkdtemp(prefix="bench_sweep_"))
+    warm_cache = work / "cache_warm"
+    reps: dict[str, list[dict]] = {
+        "serial_cold": [], "pipelined_cold": [],
+        "serial_warm": [], "pipelined_warm": [],
+    }
+    try:
+        # warms the shared cache for the *_warm settings AND absorbs
+        # process-level one-time costs (imports, first dispatch) so they
+        # don't bias the first measured setting
+        _one_run("warmup", work, warm_cache, True, 3)
+
+        # interleave settings within each repetition so host drift
+        # (the 2-core box runs other work) cancels across modes
+        for rep in range(args.reps):
+            for name, pipeline, cache in (
+                ("serial_cold", False, work / f"cache_sc{rep}"),
+                ("pipelined_cold", True, work / f"cache_pc{rep}"),
+                ("serial_warm", False, warm_cache),
+                ("pipelined_warm", True, warm_cache),
+            ):
+                reps[name].append(_one_run(
+                    f"{name}_{rep}", work, cache, pipeline, args.iters))
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    runs = {name: _aggregate(r) for name, r in reps.items()}
+    cold = runs["serial_cold"]["wall_seconds_median"]
+    summary = {
+        "speedup_vs_serial_cold": {
+            name: round(cold / r["wall_seconds_median"], 3)
+            for name, r in runs.items()
+        },
+        # the headline claim: a warm persistent cache beats the cold
+        # serial baseline, and beats its own mode's cold run too
+        "warm_below_cold_serial":
+            runs["serial_warm"]["wall_seconds_median"] < cold,
+        "warm_below_cold_per_mode": {
+            mode: (runs[f"{mode}_warm"]["wall_seconds_median"]
+                   < runs[f"{mode}_cold"]["wall_seconds_median"])
+            for mode in ("serial", "pipelined")
+        },
+    }
+    # cross-mode timing equivalence, with the same-mode noise floor it
+    # must be judged against: per-config ratio of (median across reps)
+    # medians, pipelined/serial, plus the serial run-to-run spread
+    ratios = []
+    for key, ms in runs["serial_cold"]["median_seconds_per_config"].items():
+        mp = runs["pipelined_cold"]["median_seconds_per_config"][key]
+        ratios.append(mp / ms)
+    summary["pipelined_vs_serial_median_ratio_p50"] = round(
+        _median(ratios), 3)
+    spreads = []
+    for key in reps["serial_cold"][0]["median_seconds_per_config"]:
+        vals = [r["median_seconds_per_config"][key]
+                for r in reps["serial_cold"]]
+        spreads.append(max(vals) / max(min(vals), 1e-12))
+    summary["serial_run_to_run_spread_p50"] = round(_median(spreads), 3)
+
+    import jax
+
+    record = {
+        "harness": "scripts/bench_sweep_engine.py",
+        "grid": "2 ops x 2 sizes x 2 rank counts, 8-device simulated mesh",
+        "iterations_per_config": args.iters,
+        "repetitions": args.reps,
+        "backend": jax.default_backend(),
+        "jax_version": jax.__version__,
+        "timestamp": time.time(),
+        "runs": runs,
+        "summary": summary,
+    }
+    Path(args.output).write_text(json.dumps(record, indent=2) + "\n")
+    print(json.dumps(summary, indent=2))
+    print(f"written to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,11 +59,38 @@ from dlbb_tpu.bench.runner import (  # noqa: E402
     EXTENDED_DATA_SIZES_1D,
     Sweep1D,
     Sweep3D,
-    run_sweep,
 )
+from dlbb_tpu.bench.runner import run_sweep as _run_sweep  # noqa: E402
+from dlbb_tpu.bench.schedule import MANIFEST_NAME  # noqa: E402
 
 RESULTS = REPO / "results"
 STATS = REPO / "stats"
+
+
+def run_sweep(sweep, **kw):
+    """The library driver plus a per-stage log of the sweep engine's
+    manifest (wall vs compile seconds, persistent-cache hits) — the
+    publisher is the time-budgeted caller the compile-ahead pipeline and
+    warm-cache re-runs exist for, so every stage records its win."""
+    t0 = time.time()
+    written = _run_sweep(sweep, **kw)
+    manifest = Path(sweep.output_dir) / MANIFEST_NAME
+    if manifest.exists():
+        m = json.loads(manifest.read_text())
+        if m.get("timestamp", 0) < t0:
+            # a fully-gated run (e.g. a 16-rank stage without the
+            # DLBB_PUBLISH_DEVICES=16 invocation) writes no manifest —
+            # never report a previous run's numbers as this run's
+            return written
+        cc = m.get("compile_cache", {})
+        log(
+            f"  [engine] wall {m.get('wall_seconds', 0):.1f}s, compile "
+            f"{m.get('compile_seconds_total', 0):.1f}s "
+            f"({'pipelined' if m.get('pipeline') else 'serial'}; "
+            f"xla-cache hits {cc.get('persistent_hits', 0)}/"
+            f"{cc.get('persistent_hits', 0) + cc.get('persistent_misses', 0)})"
+        )
+    return written
 
 # Sweeps resume by default: the publisher is time-budgeted and routinely
 # interrupted, and one-JSON-per-config makes resumption natural (the

@@ -18,4 +18,10 @@ JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze all --simulate 8 \
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
     -p no:cacheprovider
 
+# compile-ahead sweep-engine smoke (bench/schedule.py is covered by the
+# lint pass above; this exercises the pipelined path end-to-end on the
+# simulated mesh — 2-op mini-sweep, compile accounting, manifest)
+JAX_PLATFORMS=cpu python -m pytest tests/test_bench.py -q \
+    -m pipeline_smoke -p no:cacheprovider
+
 echo "comm-lint: clean (report: $REPORT)"

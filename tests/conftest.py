@@ -36,6 +36,11 @@ def pytest_configure(config):
         "tpu: needs a real TPU chip (compiled pallas path); run with "
         "DLBB_TPU_TESTS=1 pytest -m tpu",
     )
+    config.addinivalue_line(
+        "markers",
+        "pipeline_smoke: compile-ahead sweep-engine smoke (tier-1; also "
+        "invoked standalone by scripts/run_static_analysis.sh)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

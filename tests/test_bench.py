@@ -139,6 +139,66 @@ def test_sweep_refuses_unsupported_compiler_options(tmp_path, devices):
         run_sweep(sweep, verbose=False)
 
 
+def test_estimate_global_bytes_pinned_per_op():
+    """The memory-cap estimator derives its input AND output multipliers
+    from the op registry's declared buffer kinds (per_rank -> P,
+    per_peer -> P^2) — pinned here for every registered op so a registry
+    change that alters an estimate is a visible diff, and a new op can
+    never silently fall back to a hard-coded name list's default.
+
+    (For the pre-registry hard-coded list the per_rank-output ops —
+    sendrecv/broadcast included — all multiply by exactly P; the pins
+    freeze that contract.)"""
+    from dlbb_tpu.bench.runner import _estimate_global_bytes
+    from dlbb_tpu.comm.ops import OPERATIONS
+
+    p, n, itemsize = 4, 256, 4  # ranks, elements, float32
+    expected_mults = {  # (in + out) multiplier per op
+        "allreduce": p + p,
+        "allgather": p + p * p,
+        "broadcast": p + p,
+        "gather": p + p * p,
+        "scatter": p * p + p,
+        "reduce": p + p,
+        "alltoall": p * p + p * p,
+        "sendrecv": p + p,
+        "reducescatter": p * p + p,
+        "allreduce_hierarchical": p + p,
+    }
+    assert sorted(expected_mults) == sorted(OPERATIONS)  # full coverage
+    s = Sweep1D(dtype="float32")
+    for op_name, mult in expected_mults.items():
+        est = _estimate_global_bytes(
+            s, {"operation": op_name, "num_elements": n}, p
+        )
+        assert est == mult * n * itemsize, op_name
+
+
+@pytest.mark.pipeline_smoke
+def test_pipeline_smoke_two_op_mini_sweep(tmp_path, devices):
+    """Marker-gated smoke for the compile-ahead engine (also invoked by
+    scripts/run_static_analysis.sh): a 2-op pipelined mini-sweep measures,
+    records compile accounting in every artifact, and writes the sweep
+    manifest."""
+    sweep = _tiny_1d(
+        tmp_path, operations=("allreduce", "allgather"),
+        data_sizes=(("1KB", 256),), rank_counts=(4,),
+        compile_cache=str(tmp_path / "xc"), pipeline=True,
+    )
+    files = run_sweep(sweep, verbose=False)
+    assert len(files) == 2
+    for f in files:
+        data = json.loads(f.read_text())
+        assert data["compile_seconds"] >= 0.0
+        assert isinstance(data["compile_cache_hit"], bool)
+    man = json.loads(
+        (tmp_path / "results" / "sweep_manifest.json").read_text()
+    )
+    assert man["pipeline"] is True
+    assert man["work_units"]["unique"] == 2
+    assert man["configs"]["measured"] == 2
+
+
 def test_variant_axis_order_meshes():
     """grid/hier axis-order variants resolve to transposed meshes; ring
     fallback covers other rank counts."""
