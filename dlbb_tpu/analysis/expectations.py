@@ -60,6 +60,18 @@ OP_EXPECTED_KINDS: dict[str, dict] = {
         "required_any": {"reduce-scatter", "all-reduce"},
     },
     "barrier": {"required": "all-reduce", "allowed": {"all-reduce"}},
+    # Collective-matmul micro-ops, FUSED schedule (the registry default).
+    # The decomposed ring/bidir schedules are audited via
+    # ``overlap_op_expectation`` below — they must contain the
+    # collective-permute chain and NOTHING else.
+    "ag_matmul": {"required": "all-gather", "allowed": {"all-gather"}},
+    "matmul_rs": {
+        "required": "reduce-scatter",
+        # same CPU legalisation latitude as `reducescatter`: psum_scatter
+        # may lower to all-reduce + slice
+        "allowed": {"reduce-scatter", "all-reduce"},
+        "required_any": {"reduce-scatter", "all-reduce"},
+    },
 }
 
 # Parallelism axis -> collective kinds that axis may introduce.
@@ -75,6 +87,14 @@ OP_EXPECTED_KINDS: dict[str, dict] = {
 AXIS_EXPECTED_KINDS: dict[str, set[str]] = {
     "dp": {"all-reduce", "reduce-scatter", "all-gather"},  # DDP / ZeRO
     "tp": {"all-reduce", "collective-permute"},  # row psum + QKV realign
+    # tp with the overlapped collective-matmul schedule
+    # (model.tp_overlap = ring|bidir): every projection's collective is a
+    # ppermute chain; the ONLY legitimate all-gather is the single
+    # activation-sized reshard back to the caller's batch layout after the
+    # final layernorm.  all-reduce is deliberately absent — a surviving
+    # all-reduce means the decomposition collapsed back to the fused
+    # lowering.
+    "tp_overlap": {"collective-permute", "all-gather"},
     "sp_ring": {"collective-permute"},                      # ring attention
     "sp_ulysses": {"all-to-all"},                           # Ulysses resharding
     "pp": {"collective-permute", "all-reduce"},             # hops + masked psum
@@ -84,17 +104,21 @@ AXIS_EXPECTED_KINDS: dict[str, set[str]] = {
 
 def plan_expected_kinds(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
                         ep: int = 1, attention: str = "full",
-                        zero_stage: int = 0) -> set[str]:
-    """The union of collective kinds a (plan, attention, ZeRO stage)
-    combination is allowed to lower to.  Anything else in the compiled
-    module — most importantly an all-gather in a plain TP forward — is a
-    sharding mismatch."""
+                        zero_stage: int = 0,
+                        tp_overlap: str = "off") -> set[str]:
+    """The union of collective kinds a (plan, attention, ZeRO stage,
+    tp_overlap schedule) combination is allowed to lower to.  Anything
+    else in the compiled module — most importantly an all-gather in a
+    plain TP forward, or a surviving all-reduce in an overlapped one — is
+    a sharding mismatch."""
     kinds: set[str] = set()
     if dp > 1:
         kinds |= ({"all-reduce"} if zero_stage == 0
                   else AXIS_EXPECTED_KINDS["dp"])
     if tp > 1:
-        kinds |= AXIS_EXPECTED_KINDS["tp"]
+        kinds |= AXIS_EXPECTED_KINDS[
+            "tp_overlap" if tp_overlap != "off" else "tp"
+        ]
     if sp > 1:
         kinds |= AXIS_EXPECTED_KINDS[
             "sp_ring" if attention == "ring" else "sp_ulysses"
@@ -176,4 +200,23 @@ def op_expectation(op_name: str, payload_bytes_per_rank: int,
         required_any=set(required_any),
         min_required=spec.get("min_required", 1),
         max_bytes_per_instr=int(payload_bytes_per_rank * slack),
+    )
+
+
+def overlap_op_expectation(p: int, chunk_bytes: int,
+                           slack: float = 1.25) -> TargetExpectation:
+    """Expectation for a RING-DECOMPOSED collective matmul (either op,
+    either direction): the lowered program must be a pure
+    collective-permute chain — at least ``p - 1`` hops (the unidirectional
+    ring's count; the bidirectional all-gather ring splits the same count
+    across two directions, the bidirectional reduce-scatter doubles it
+    with half-sized messages), each carrying at most one travelling chunk
+    (``chunk_bytes``) — and no fused collective may survive: an
+    all-gather or reduce-scatter here means XLA undid the decomposition
+    and the overlap claim is void."""
+    return TargetExpectation(
+        allowed={"collective-permute"},
+        required_any={"collective-permute"},
+        min_required=p - 1,
+        max_bytes_per_instr=int(chunk_bytes * slack),
     )

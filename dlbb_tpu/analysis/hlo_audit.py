@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional, Sequence
 from dlbb_tpu.analysis.expectations import (
     TargetExpectation,
     op_expectation,
+    overlap_op_expectation,
     plan_expected_kinds,
     wire_bytes,
 )
@@ -160,6 +161,56 @@ def _instr_details(instr: CollectiveInstr, exp: TargetExpectation) -> dict:
 _TINY_MODEL = dict(hidden_size=64, num_layers=2, num_heads=4,
                    ffn_intermediate=128, dtype="float32",
                    attention="full")
+
+
+# (B, S, H) audit payload for the collective-matmul targets: S and H
+# divisible by the 8-rank ring, small enough to lower in milliseconds
+_MATMUL_SHAPE = (2, 16, 64)
+
+
+def _collective_matmul_target(op_name: str, schedule: str,
+                              num_ranks: int = 8) -> AuditTarget:
+    """One audit target per (micro-op, schedule).  The fused schedule must
+    show its defining gather/scatter; the decomposed schedules must show
+    the pure collective-permute chain (``overlap_op_expectation``) —
+    comm-lint is the correctness gate for the overlap claim."""
+    import numpy as np
+
+    def build():
+        import jax.numpy as jnp
+
+        from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+        from dlbb_tpu.comm.ops import (
+            build_ag_matmul,
+            build_matmul_rs,
+            get_op,
+            make_payload,
+        )
+
+        mesh = build_mesh(MeshSpec.ring(num_ranks))
+        builder = (build_ag_matmul if op_name == "ag_matmul"
+                   else build_matmul_rs)
+        fn = builder(mesh, ("ranks",), schedule=schedule)
+        x = make_payload(
+            get_op(op_name), mesh, ("ranks",),
+            int(np.prod(_MATMUL_SHAPE)), dtype=jnp.float32,
+            shape=_MATMUL_SHAPE,
+        )
+        return fn, (x,)
+
+    per_rank = int(np.prod(_MATMUL_SHAPE)) * 4  # float32
+    if schedule == "fused":
+        # the gather/scatter result may span the whole gathered payload
+        exp = op_expectation(op_name, per_rank * num_ranks)
+    else:
+        # each hop carries at most one travelling per-rank chunk
+        exp = overlap_op_expectation(num_ranks, per_rank)
+    return AuditTarget(
+        name=f"comm/ops.py::{op_name}[{schedule}]",
+        build=build,
+        expectation=exp,
+        min_devices=num_ranks,
+    )
 
 
 def _registry_op_target(op_name: str, num_ranks: int = 8,
@@ -302,6 +353,120 @@ def _cp_forward_target(attention: str, dp: int = 2, sp: int = 4) -> AuditTarget:
     )
 
 
+def _tp_overlap_forward_target(schedule: str, dp: int = 2,
+                               tp: int = 4) -> AuditTarget:
+    """The overlapped TP forward (model.tp_overlap = ring|bidir).  The
+    audit is the correctness gate for the decomposition: every projection
+    collective must be a ppermute chain (>= 4 ring matmuls x (tp-1) hops
+    in the scanned layer body), NO all-reduce may survive, and the only
+    all-gather allowed is the single activation-sized reshard back to the
+    caller's batch layout — anything bigger means the Megatron layout
+    collapsed or the decomposition was undone."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        from dlbb_tpu.comm.mesh import build_parallelism_mesh
+        from dlbb_tpu.models.configs import ModelConfig
+        from dlbb_tpu.models.sharding import batch_spec
+        from dlbb_tpu.models.transformer import (
+            forward,
+            init_params_sharded,
+        )
+
+        cfg = ModelConfig(**_TINY_MODEL, tp_overlap=schedule)
+        mesh = build_parallelism_mesh(data_parallel=dp, tensor_parallel=tp)
+        params = init_params_sharded(cfg, jax.random.key(0), mesh)
+        x = jax.device_put(
+            jnp.ones((2 * dp, 8, cfg.hidden_size), jnp.float32),
+            NamedSharding(mesh, batch_spec(mesh)),
+        )
+        fn = jax.jit(
+            lambda p, a: forward(p, a, cfg, mesh=mesh),
+            out_shardings=NamedSharding(mesh, batch_spec(mesh)),
+        )
+        return fn, (params, x)
+
+    # per-device activation shard: [B/dp, S, H] f32 — the ceiling for the
+    # final reshard gather AND every travelling ring chunk (chunks are
+    # 1/tp of it)
+    act_bytes = (2 * dp // dp) * 8 * _TINY_MODEL["hidden_size"] * 4
+    return AuditTarget(
+        name=f"models/transformer.py::forward[dp,tp,overlap={schedule}]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(tp=tp, tp_overlap=schedule),
+            required_any={"collective-permute"},
+            # 4 ring matmuls per scanned layer body, (tp-1) hops each
+            min_required=4 * (tp - 1),
+            max_bytes_per_instr=int(act_bytes * 1.25),
+        ),
+        min_devices=dp * tp,
+    )
+
+
+def _tp_overlap_train_target(schedule: str, dp: int = 2,
+                             tp: int = 4) -> AuditTarget:
+    """The overlapped train step: the custom VJP must keep the backward
+    on ppermute chains too (forward + dx + dw rings), with the only
+    all-reduces the dp gradient reductions (weight-shard sized, inserted
+    by the psum over batch axes inside the weight-grad rings) — and the
+    state donation of the train-step convention intact."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+
+        import optax
+
+        from dlbb_tpu.comm.mesh import build_parallelism_mesh
+        from dlbb_tpu.models.configs import ModelConfig
+        from dlbb_tpu.models.sharding import batch_spec
+        from dlbb_tpu.models.transformer import init_params_sharded
+        from dlbb_tpu.train.loop import make_train_step
+
+        cfg = ModelConfig(**_TINY_MODEL, tp_overlap=schedule)
+        mesh = build_parallelism_mesh(data_parallel=dp, tensor_parallel=tp)
+        params = init_params_sharded(cfg, jax.random.key(0), mesh)
+        jit_step, state = make_train_step(
+            cfg, mesh, optax.adam(1e-3), params, zero_stage=0,
+        )
+        sharding = NamedSharding(mesh, batch_spec(mesh))
+        batch = jax.device_put(
+            jnp.ones((2 * dp, 8, cfg.hidden_size), jnp.float32), sharding)
+        tgt = jax.device_put(
+            jnp.ones((2 * dp, 8, cfg.hidden_size), jnp.float32), sharding)
+        return jit_step, (state, batch, tgt)
+
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.models.transformer import num_parameters
+
+    # combined dp weight-grad all-reduces are bounded by the full f32
+    # parameter pytree; every ring chunk and the final activation reshard
+    # are far below it
+    params_bytes = num_parameters(ModelConfig(**_TINY_MODEL)) * 4
+    return AuditTarget(
+        name=f"train/loop.py::train_step[dp,tp,overlap={schedule}]",
+        build=build,
+        expectation=TargetExpectation(
+            # all-to-all: GSPMD reshards the scanned backward's
+            # broadcast-zero cotangent init with a (tiny, constant-operand)
+            # all-to-all on this jaxlib — covered by the byte ceiling, and
+            # absent from the forward target where the strict set holds
+            allowed=plan_expected_kinds(dp=dp, tp=tp, tp_overlap=schedule)
+            | {"all-to-all"},
+            required_any={"collective-permute"},
+            # forward chain alone is 4 rings x (tp-1); the backward adds
+            # its own dx/dw rings on top
+            min_required=4 * (tp - 1),
+            max_bytes_per_instr=int(params_bytes * 1.25),
+            expect_donation=True,
+        ),
+        min_devices=dp * tp,
+    )
+
+
 def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
     def build():
         import jax
@@ -343,23 +508,38 @@ def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
 
 
 def registry_op_targets() -> list[AuditTarget]:
-    """One audit target per ``comm/ops.py`` registry collective."""
-    from dlbb_tpu.comm.ops import OPERATIONS
+    """One audit target per ``comm/ops.py`` registry collective — the
+    collective-matmul micro-ops need LLM-shaped payloads and get one
+    dedicated target per schedule (fused vs the decomposed rings)."""
+    from dlbb_tpu.comm.ops import MATMUL_OPS, OPERATIONS
 
-    return [_registry_op_target(name) for name in sorted(OPERATIONS)]
+    targets = [
+        _registry_op_target(name)
+        for name in sorted(OPERATIONS) if name not in MATMUL_OPS
+    ]
+    targets += [
+        _collective_matmul_target(name, schedule)
+        for name in MATMUL_OPS
+        for schedule in ("fused", "ring", "bidir")
+    ]
+    return targets
 
 
 def default_targets() -> list[AuditTarget]:
     """The repo's standing audit surface: every registry collective, the
-    TP/sequence-parallel model forwards (the e2e benchmark's jit), and the
-    DDP + ZeRO-1 train steps."""
+    TP/sequence-parallel model forwards (the e2e benchmark's jit) with
+    and without the overlapped collective-matmul schedule, and the
+    DDP + ZeRO-1 + overlapped-TP train steps."""
     targets = registry_op_targets()
     targets.append(_barrier_target())
     targets.append(_tp_forward_target())
+    targets.append(_tp_overlap_forward_target("ring"))
+    targets.append(_tp_overlap_forward_target("bidir"))
     targets.append(_cp_forward_target("ring"))
     targets.append(_cp_forward_target("ulysses"))
     targets.append(_train_step_target(zero_stage=0))
     targets.append(_train_step_target(zero_stage=1))
+    targets.append(_tp_overlap_train_target("ring"))
     return targets
 
 

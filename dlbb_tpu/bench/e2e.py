@@ -147,6 +147,9 @@ def run_e2e(
             "num_parameters": num_parameters(model_cfg),
             "attention": model_cfg.attention,
             "dtype": model_cfg.dtype,
+            # TP collective-matmul schedule (off = GSPMD fused; ring/bidir
+            # = overlapped decomposition, docs/overlap.md)
+            "tp_overlap": model_cfg.tp_overlap,
         },
         "mesh": plan.mesh_dict(),
         "init_time_s": init_time,
@@ -184,7 +187,13 @@ def run_e2e_from_config(
     config_path: str,
     output_dir: Optional[str] = None,
     devices: Optional[Sequence] = None,
+    tp_overlap: Optional[str] = None,
 ) -> dict[str, Any]:
+    """``tp_overlap`` overrides the config's ``model.tp_overlap`` (the
+    ``--tp-overlap`` CLI flag): one YAML can be swept fused-vs-ring-vs-
+    bidir without editing it."""
     config = load_config(config_path)
+    if tp_overlap is not None:
+        config.setdefault("model", {})["tp_overlap"] = tp_overlap
     out = output_dir or config.get("experiment", {}).get("output_dir")
     return run_e2e(config, devices=devices, output_dir=out)

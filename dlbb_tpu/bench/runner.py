@@ -39,6 +39,7 @@ import numpy as np
 from dlbb_tpu.bench import schedule
 from dlbb_tpu.comm.mesh import get_mesh
 from dlbb_tpu.comm.ops import (
+    MATMUL_OPS,
     build_allreduce_hierarchical,
     get_op,
     make_payload,
@@ -232,6 +233,12 @@ _NULL_GATE = contextlib.nullcontext()
 def _build_fn(op_name: str, variant: Variant, mesh, axes, root: int):
     if op_name == "allreduce" and variant.hierarchical:
         return build_allreduce_hierarchical(mesh, axes, root)
+    if op_name in MATMUL_OPS and variant.overlap_schedule is not None:
+        # decomposed collective-matmul schedule (docs/overlap.md) — same
+        # dispatch convention as `hierarchical` above
+        return get_op(op_name).build(
+            mesh, axes, root, schedule=variant.overlap_schedule
+        )
     return get_op(op_name).build(mesh, axes, root)
 
 
@@ -504,9 +511,22 @@ def _estimate_global_bytes(sweep, config, num_ranks: int) -> int:
     n = _payload_geometry(sweep, config)[0]
     itemsize = jnp.dtype(_dtype_of(sweep.dtype)).itemsize
     p = num_ranks
-    in_mult = p * p if op.input_kind == "per_peer" else p
-    out_mult = p * p if op.output_kind == "per_peer" else p
-    return (in_mult + out_mult) * n * itemsize
+
+    def mult(kind):
+        return p * p if kind == "per_peer" else p
+
+    transient = mult(op.transient_kind) if op.transient_kind else 0
+    if (transient and op.name in MATMUL_OPS
+            and get_variant(sweep.variant).overlap_schedule is not None):
+        # the declared transient models the FUSED schedule (the gathered
+        # activation / full partial product); the decomposed ring never
+        # materialises it — one travelling chunk rides inside the in+out
+        # estimate, so charging the fused footprint would skip exactly
+        # the configs whose memory behavior the overlap variant exists
+        # to demonstrate
+        transient = 0
+    return (mult(op.input_kind) + mult(op.output_kind) + transient) \
+        * n * itemsize
 
 
 def _iter_configs(sweep):

@@ -99,6 +99,12 @@ def build_parser() -> argparse.ArgumentParser:
     e2.add_argument("--config", required=True, help="YAML experiment config")
     e2.add_argument("--simulate", type=int, default=0, metavar="N")
     e2.add_argument("--output", default=None)
+    e2.add_argument("--tp-overlap", default=None,
+                    choices=("off", "ring", "bidir"), dest="tp_overlap",
+                    help="override model.tp_overlap: off = GSPMD fused TP "
+                         "collectives, ring/bidir = ring-decomposed "
+                         "collective matmuls overlapping comm with compute "
+                         "(docs/overlap.md)")
     _add_trace(e2)
 
     rp = sub.add_parser(
@@ -140,6 +146,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="ZeRO stage: 0=DDP, 1=opt-state sharding, "
                          "2=+grad reduce-scatter, 3=FSDP param sharding")
     tr.add_argument("--output", default=None)
+    tr.add_argument("--tp-overlap", default=None,
+                    choices=("off", "ring", "bidir"), dest="tp_overlap",
+                    help="override model.tp_overlap (see the e2e flag)")
     _add_trace(tr)
 
     return ap
@@ -394,7 +403,8 @@ def _dispatch(args) -> int:
             print("error: the e2e benchmark module is not available in this build")
             return 2
 
-        result = run_e2e_from_config(args.config, output_dir=args.output)
+        result = run_e2e_from_config(args.config, output_dir=args.output,
+                                     tp_overlap=args.tp_overlap)
         print(f"forward mean {result['forward_time']['mean'] * 1e3:.2f} ms")
         return 0
 
@@ -407,7 +417,7 @@ def _dispatch(args) -> int:
 
         result = run_train_from_config(
             args.config, zero1=args.zero1, zero_stage=args.zero_stage,
-            output_dir=args.output,
+            output_dir=args.output, tp_overlap=args.tp_overlap,
         )
         print(f"step mean {result['step_time']['mean'] * 1e3:.2f} ms")
         return 0

@@ -59,6 +59,14 @@ class CollectiveOp:
     so memory estimates (``runner._estimate_global_bytes``) derive their
     multipliers from the registry instead of hard-coded op-name lists.
 
+    ``transient_kind`` declares the largest *intermediate* the op
+    materialises beyond input+output (None for ops that stream through
+    collectives directly): ``ag_matmul``'s fused schedule holds the
+    gathered ``[B, P*S, H]`` activation on every device (per_peer:
+    P^2 x payload globally), ``matmul_rs`` a full per-device partial
+    product (per_rank) — without this the memory-cap gate would admit
+    configs whose true footprint is ~P/2x the in+out estimate.
+
     make_chain(P) returns glue mapping the op's output back to a valid next
     input, used by chained timing (``dlbb_tpu.utils.timing``) to iterate the
     op inside one jitted loop without letting XLA hoist it; None means the
@@ -70,6 +78,7 @@ class CollectiveOp:
     output_kind: str
     build: Callable[..., Callable]  # (mesh, axes, root) -> fn(global) -> global
     make_chain: Optional[Callable[[int], Callable]] = None
+    transient_kind: Optional[str] = None
 
 
 # Payload RNG seed shared by make_payload and payload_cache_key: the cache
@@ -249,6 +258,142 @@ def build_reducescatter(mesh, axes, root=0):
     return _wrap(mesh, axes, body, 3, 3)
 
 
+def _synth_weight(rows: int, cols: int, dtype, row_offset=0, col_offset=0):
+    """Deterministic dense weight generated ON DEVICE (broadcasted iota +
+    cosine) — a host-side constant at these sizes would be embedded in the
+    jitted program and stall compilation (see utils/timing.py).  The
+    offsets select a shard of the logical global weight, so every rank's
+    shard agrees with one global matrix and fused-vs-decomposed outputs
+    are comparable bit-for-bit in tests."""
+    i = jax.lax.broadcasted_iota(jnp.float32, (rows, cols), 0) + row_offset
+    j = jax.lax.broadcasted_iota(jnp.float32, (rows, cols), 1) + col_offset
+    return (jnp.cos(i * 0.37 + j * 0.11) / np.sqrt(rows)).astype(dtype)
+
+
+# The collective-matmul micro-ops, in one place: the runner's variant
+# dispatch and the HLO audit's per-schedule targets both key off this
+# tuple, so registering a third matmul op cannot silently miss either.
+MATMUL_OPS = ("ag_matmul", "matmul_rs")
+
+_MICRO_SCHEDULES = ("fused", "ring", "bidir")
+
+
+def _check_micro_schedule(schedule: str) -> None:
+    if schedule not in _MICRO_SCHEDULES:
+        raise ValueError(
+            f"unknown collective-matmul schedule {schedule!r}; known: "
+            f"{_MICRO_SCHEDULES}"
+        )
+
+
+def _require_3d_payload(op_name: str, x) -> None:
+    """Global [P, B, S, H] payload gate for the collective-matmul ops —
+    checked BEFORE shard_map so a flat 1D payload fails with a pointer at
+    bench3d instead of a spec-arity error."""
+    if x.ndim != 4:
+        raise ValueError(
+            f"{op_name} needs an LLM-shaped (B, S, H) payload — run it "
+            "through the 3D sweep (bench3d / Sweep3D), not the flat 1D one"
+        )
+
+
+def build_ag_matmul(mesh, axes, root=0, schedule="fused"):
+    """All-gather + matmul microbenchmark (the column-parallel TP
+    projection in isolation; model dispatch in ``models/transformer.py``).
+
+    Payload: per-rank ``[B, S, H]`` — this rank's sequence chunk.  Each
+    rank multiplies the gathered ``[B, P*S, H]`` sequence by its column
+    shard of a deterministic ``[H, H]`` weight, producing ``[B, P*S, H/P]``
+    (same per-rank bytes as the input).
+
+    ``schedule``: "fused" = one ``all_gather`` then the matmul (what GSPMD
+    emits for the Megatron layout); "ring"/"bidir" = the decomposed
+    overlapped schedule of ``parallel/collective_matmul.py`` — the sweep
+    engine measures the two against each other via the ``overlap_ring`` /
+    ``overlap_bidir`` variants.
+    """
+    if len(axes) != 1:
+        raise ValueError("ag_matmul requires a single mesh axis")
+    _check_micro_schedule(schedule)
+    num = mesh_num_ranks(mesh, axes)
+
+    def body(x):  # local [1, B, S, H] -> [1, B, P*S, H/P]
+        xl = x[0]
+        b, s, h = xl.shape
+        if h % num != 0:
+            raise ValueError(
+                f"ag_matmul: hidden dim {h} not divisible by {num} ranks"
+            )
+        hp = h // num
+        r = jax.lax.axis_index(axes[0])
+        w = _synth_weight(h, hp, xl.dtype, col_offset=r * hp)
+        if schedule == "fused":
+            g = jax.lax.all_gather(xl, axes[0])        # [P, B, S, H]
+            g = jnp.moveaxis(g, 0, 1).reshape(b, num * s, h)
+            out = g @ w
+        else:
+            from dlbb_tpu.parallel.collective_matmul import _ag_matmul_body
+
+            out = _ag_matmul_body(xl, w, axes[0], num,
+                                  bidir=schedule == "bidir")
+        return out[None]
+
+    inner = _wrap(mesh, axes, body, 4, 4)
+
+    def guarded(x):
+        _require_3d_payload("ag_matmul", x)
+        return inner(x)
+
+    return jax.jit(guarded)
+
+
+def build_matmul_rs(mesh, axes, root=0, schedule="fused"):
+    """Matmul + reduce-scatter microbenchmark (the row-parallel TP
+    projection in isolation).
+
+    Payload: per-rank ``[B, S, H]`` — this rank's *feature* shard of a
+    ``[B, S, P*H]`` activation.  Each rank multiplies by its row shard of
+    a deterministic ``[P*H, H]`` weight and the partial products are
+    reduce-scattered over the sequence dim to ``[B, S/P, H]`` chunks.
+
+    ``schedule``: "fused" = local matmul + ``psum_scatter``; "ring"/
+    "bidir" = the decomposed overlapped schedule.
+    """
+    if len(axes) != 1:
+        raise ValueError("matmul_rs requires a single mesh axis")
+    _check_micro_schedule(schedule)
+    num = mesh_num_ranks(mesh, axes)
+
+    def body(x):  # local [1, B, S, H] -> [1, B, S/P, H]
+        xl = x[0]
+        b, s, h = xl.shape
+        if s % num != 0:
+            raise ValueError(
+                f"matmul_rs: sequence {s} not divisible by {num} ranks"
+            )
+        r = jax.lax.axis_index(axes[0])
+        w = _synth_weight(h, h, xl.dtype, row_offset=r * h)
+        if schedule == "fused":
+            partial = xl @ w                            # [B, S, H]
+            out = jax.lax.psum_scatter(
+                partial, axes[0], scatter_dimension=1, tiled=True
+            )                                           # [B, S/P, H]
+        else:
+            from dlbb_tpu.parallel.collective_matmul import _matmul_rs_body
+
+            out = _matmul_rs_body(xl, w, axes[0], num,
+                                  bidir=schedule == "bidir")
+        return out[None]
+
+    inner = _wrap(mesh, axes, body, 4, 4)
+
+    def guarded(x):
+        _require_3d_payload("matmul_rs", x)
+        return inner(x)
+
+    return jax.jit(guarded)
+
+
 def build_barrier(mesh, axes, root=0):
     """Barrier analogue (reference ``collectives/1d/openmpi.py:60``:
     ``comm.Barrier()`` before each timed op).  In XLA's async-dispatch model a
@@ -290,6 +435,23 @@ def _chain_scatter_back(p: int):
     return chain
 
 
+def _chain_ag_matmul(p: int):
+    def chain(out):  # [P, B, P*S, H/P] -> [P, B, S, H] (local reshuffle)
+        q, b, ps, hp = out.shape
+        return out.reshape(q, b, ps // p, hp * p)
+
+    return chain
+
+
+def _chain_matmul_rs(p: int):
+    def chain(out):  # [P, B, S/P, H] -> [P, B, S, H], damped (p-term sums)
+        q, b, sp_, h = out.shape
+        tiled = jnp.broadcast_to(out[:, :, None], (q, b, p, sp_, h))
+        return tiled.reshape(q, b, p * sp_, h) * (1.0 / p)
+
+    return chain
+
+
 OPERATIONS: dict[str, CollectiveOp] = {
     "allreduce": CollectiveOp(
         "allreduce", "per_rank", "per_rank", build_allreduce, _chain_rescale
@@ -323,6 +485,19 @@ OPERATIONS: dict[str, CollectiveOp] = {
     "allreduce_hierarchical": CollectiveOp(
         "allreduce_hierarchical", "per_rank", "per_rank",
         build_allreduce_hierarchical, _chain_rescale,
+    ),
+    # Collective-matmul micro-ops (docs/overlap.md): the TP projection
+    # halves in isolation, 3D (B, S, H) payloads only.  The default build
+    # is the FUSED schedule; the overlap_ring / overlap_bidir variants
+    # (comm/variants.py) swap in the ring-decomposed schedule so the sweep
+    # engine measures fused-vs-decomposed on identical payloads.
+    "ag_matmul": CollectiveOp(
+        "ag_matmul", "per_rank", "per_rank", build_ag_matmul,
+        _chain_ag_matmul, transient_kind="per_peer",
+    ),
+    "matmul_rs": CollectiveOp(
+        "matmul_rs", "per_rank", "per_rank", build_matmul_rs,
+        _chain_matmul_rs, transient_kind="per_rank",
     ),
 }
 

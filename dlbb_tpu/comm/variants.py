@@ -41,6 +41,12 @@ class Variant:
     mesh_axis_names: Optional[tuple[str, ...]] = None
     # use the explicit per-axis hierarchical allreduce builder
     hierarchical: bool = False
+    # collective-matmul schedule for the ag_matmul / matmul_rs micro-ops:
+    # None = fused (all-gather / psum_scatter, the GSPMD lowering);
+    # "ring" / "bidir" = the ring-decomposed overlapped schedule of
+    # dlbb_tpu/parallel/collective_matmul.py.  Ignored by every other op
+    # (a tuning knob, like `hierarchical` for allreduce).
+    overlap_schedule: Optional[str] = None
     # XLA_FLAGS fragments a launcher must set before process start
     xla_flags: tuple[str, ...] = ()
     # per-computation XLA compiler options (jit(...).lower().compile(...)),
@@ -148,6 +154,20 @@ VARIANTS: dict[str, Variant] = {
         mesh_shape=(2, 2, 2),
         mesh_axis_names=("x", "y", "z"),
         hierarchical=True,
+    ),
+    "overlap_ring": Variant(
+        "overlap_ring",
+        "ring-decomposed collective matmul: ppermute chain hides the "
+        "gather/scatter behind per-shard partial matmuls (ag_matmul / "
+        "matmul_rs micro-ops; fused baseline = the default variant)",
+        overlap_schedule="ring",
+    ),
+    "overlap_bidir": Variant(
+        "overlap_bidir",
+        "bidirectional-ring collective matmul: both ICI directions per "
+        "step — half the hops for ag_matmul, half-sized messages both "
+        "ways for matmul_rs",
+        overlap_schedule="bidir",
     ),
     "nofuse": Variant(
         "nofuse",

@@ -60,6 +60,21 @@ class ModelConfig:
     # scanned block) — trades ~1/3 more FLOPs for O(layers) less activation
     # HBM, the standard TPU memory/compute trade.
     remat: bool = False
+    # Overlapped tensor-parallel collective-matmul schedule
+    # (dlbb_tpu/parallel/collective_matmul.py):
+    # - "off": GSPMD Megatron layout — XLA inserts the per-layer TP
+    #   all-reduces (the default; unchanged lowering);
+    # - "ring": every TP projection becomes a ring-decomposed
+    #   all-gather-matmul / matmul-reduce-scatter — the collective is a
+    #   chain of neighbour ppermutes hidden behind per-shard partial
+    #   matmuls, and activations between blocks live sequence-sharded
+    #   over tp;
+    # - "bidir": same decomposition on a bidirectional ring (both ICI
+    #   directions per step; half the hops for the all-gather side).
+    # Requires tp > 1, pp == 1, a dense (non-MoE) FFN, and sequence
+    # length divisible by the sequence-shard count — validated by
+    # validate_tp_overlap below.
+    tp_overlap: str = "off"
     # Rematerialisation policy (effective only with remat=True):
     # - "full": save nothing per block, recompute the whole block forward
     #   in the backward pass (max memory saving, ~+1 forward of recompute);
@@ -97,6 +112,11 @@ class ModelConfig:
             raise ValueError(
                 f"moe_capacity_factor must be > 0, got "
                 f"{self.moe_capacity_factor}"
+            )
+        if self.tp_overlap not in ("off", "ring", "bidir"):
+            raise ValueError(
+                f"unknown tp_overlap {self.tp_overlap!r} "
+                "(expected 'off', 'ring', or 'bidir')"
             )
         if self.remat_policy not in ("full", "dots"):
             raise ValueError(
@@ -152,7 +172,8 @@ class ModelConfig:
             "hidden_size", "num_layers", "num_heads", "ffn_intermediate",
             "attention", "dtype", "num_kv_heads", "causal",
             "num_experts", "moe_top_k",
-            "moe_dispatch", "moe_capacity_factor", "remat", "remat_policy",
+            "moe_dispatch", "moe_capacity_factor", "tp_overlap",
+            "remat", "remat_policy",
         ):
             if k in d:
                 fields[k] = d[k]
@@ -181,6 +202,43 @@ def validate_attention_parallelism(config: ModelConfig, sp: int) -> None:
             f"{SP_CAPABLE_ATTENTION} (attention={config.attention!r} does "
             "not partition the sequence; it would run replicated per sp "
             "shard)"
+        )
+
+
+def validate_tp_overlap(config: ModelConfig, tp: int, pp: int = 1,
+                        seq_len: int = 0, sp: int = 1) -> None:
+    """Reject tp_overlap combinations the decomposed schedule cannot run.
+
+    The ring kernels gather/scatter the *sequence* dim over tp, so the
+    knob needs a real tp axis, an even sequence split, a dense FFN (the
+    MoE expert dispatch keeps its GSPMD lowering), and no pipeline (the
+    pipeline engine owns its own shard_map and activation layout)."""
+    if config.tp_overlap == "off":
+        return
+    if tp <= 1:
+        raise ValueError(
+            f"model.tp_overlap={config.tp_overlap!r} requires "
+            "parallelism.world_size (tp) > 1 — without a tp axis there is "
+            "no collective to overlap"
+        )
+    if pp > 1:
+        raise ValueError(
+            f"model.tp_overlap={config.tp_overlap!r} is incompatible with "
+            "pipeline_parallel > 1 (the pipeline engine owns the "
+            "activation layout)"
+        )
+    if config.is_moe:
+        raise ValueError(
+            f"model.tp_overlap={config.tp_overlap!r} requires a dense FFN "
+            "(the MoE expert dispatch is not ring-decomposed; run MoE "
+            "models with tp_overlap='off')"
+        )
+    if seq_len and seq_len % (tp * max(1, sp)) != 0:
+        raise ValueError(
+            f"input.sequence_length={seq_len} not divisible by the "
+            f"sequence-shard count {tp * max(1, sp)} (tp={tp}"
+            f"{f' x sp={sp}' if sp > 1 else ''}) required by "
+            f"tp_overlap={config.tp_overlap!r}"
         )
 
 

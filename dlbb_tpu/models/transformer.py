@@ -349,19 +349,57 @@ def _moe_ffn(y, layer: Params, config: ModelConfig):
     return out, moe_aux_loss(probs, gates, config.moe_top_k)
 
 
+def _use_tp_overlap(config: ModelConfig, mesh) -> bool:
+    """Whether this (config, mesh) pair routes TP projections through the
+    ring-decomposed collective matmuls (``parallel/collective_matmul.py``).
+    The knob is inert without a >1 tp axis, so single-device runs and
+    non-TP meshes keep the GSPMD lowering bit for bit."""
+    return (config.tp_overlap != "off" and mesh is not None
+            and "tp" in getattr(mesh, "axis_names", ())
+            and mesh.shape["tp"] > 1)
+
+
 def _block(x, layer: Params, config: ModelConfig, mesh=None,
            sp_axis: str = "sp"):
     """One transformer block (reference ``TransformerBlock.forward``
     ``models.py:147-190``); the FFN is the gated-expert mixture when
     ``config.num_experts > 0``.
 
+    With ``tp_overlap`` on, the four TP projections run as ring-decomposed
+    collective matmuls: the residual stream x enters sequence-sharded over
+    tp, each column-parallel projection gathers it behind partial matmuls
+    (``allgather_matmul``) and each row-parallel projection returns it to
+    the sequence-sharded layout behind the same ring
+    (``matmul_reducescatter``) — no exposed TP all-reduce remains.
+
     Returns ``(x, aux)`` — aux is the layer's MoE load-balancing loss
     (0.0 for the dense FFN)."""
+    if _use_tp_overlap(config, mesh):
+        from dlbb_tpu.parallel.collective_matmul import (
+            allgather_matmul,
+            matmul_reducescatter,
+        )
+
+        sched = config.tp_overlap
+
+        def col(y, kernel, bias):
+            return allgather_matmul(y, kernel, mesh, schedule=sched) + bias
+
+        def row(y, kernel, bias):
+            return matmul_reducescatter(y, kernel, mesh,
+                                        schedule=sched) + bias
+    else:
+        def col(y, kernel, bias):
+            return y @ kernel + bias
+
+        def row(y, kernel, bias):
+            return y @ kernel + bias
+
     residual = x
     y = _layernorm(x, layer["ln1"]["scale"], layer["ln1"]["bias"])
-    qkv = y @ layer["qkv"]["kernel"] + layer["qkv"]["bias"]
+    qkv = col(y, layer["qkv"]["kernel"], layer["qkv"]["bias"])
     attn = _attention(qkv, config, mesh, sp_axis)
-    x = attn @ layer["out"]["kernel"] + layer["out"]["bias"] + residual
+    x = row(attn, layer["out"]["kernel"], layer["out"]["bias"]) + residual
 
     residual = x
     y = _layernorm(x, layer["ln2"]["scale"], layer["ln2"]["bias"])
@@ -369,9 +407,10 @@ def _block(x, layer: Params, config: ModelConfig, mesh=None,
         ffn_out, aux = _moe_ffn(y, layer, config)
         x = ffn_out + residual
     else:
-        y = y @ layer["ffn_up"]["kernel"] + layer["ffn_up"]["bias"]
+        y = col(y, layer["ffn_up"]["kernel"], layer["ffn_up"]["bias"])
         y = jax.nn.gelu(y)
-        x = y @ layer["ffn_down"]["kernel"] + layer["ffn_down"]["bias"] + residual
+        x = row(y, layer["ffn_down"]["kernel"],
+                layer["ffn_down"]["bias"]) + residual
         aux = jnp.zeros((), jnp.float32)
     return x, aux
 
@@ -399,6 +438,17 @@ def forward(params: Params, x: jax.Array, config: ModelConfig,
         return pipeline_forward(
             params, x, config, mesh, pp_axis=pp_axis,
             num_microbatches=num_microbatches, with_aux=with_aux,
+        )
+
+    if _use_tp_overlap(config, mesh):
+        # pin the residual stream to the sequence-sharded-over-tp layout
+        # BEFORE the scan: the carry's sharding must be stable across
+        # iterations (every block returns this layout), and constraining
+        # the entry point keeps GSPMD from resharding per iteration
+        from dlbb_tpu.parallel.collective_matmul import activation_spec
+
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, activation_spec(mesh))
         )
 
     def body(carry, layer):

@@ -19,6 +19,7 @@ from dlbb_tpu.models.configs import (
     ModelConfig,
     validate_attention_parallelism,
     validate_expert_parallelism,
+    validate_tp_overlap,
 )
 from dlbb_tpu.parallel.pipeline import validate_pipeline
 
@@ -32,6 +33,10 @@ class ParallelismPlan:
     tp: int
     num_microbatches: Optional[int]
     mesh: Mesh
+    # the model's TP collective-matmul schedule ("off" | "ring" | "bidir"),
+    # copied from the resolved ModelConfig so harnesses can record it next
+    # to the mesh in result JSON
+    tp_overlap: str = "off"
 
     @classmethod
     def from_config(
@@ -58,6 +63,10 @@ class ParallelismPlan:
 
         validate_attention_parallelism(model_cfg, sp)
         validate_expert_parallelism(model_cfg, ep)
+        validate_tp_overlap(
+            model_cfg, tp, pp=pp, sp=sp,
+            seq_len=config.get("input", {}).get("sequence_length", 0),
+        )
         if pp > 1:
             num_microbatches = validate_pipeline(
                 model_cfg, pp, config["input"]["batch_size"],
@@ -71,7 +80,8 @@ class ParallelismPlan:
             )
 
         mesh = build_parallelism_mesh(dp, sp, pp, tp, ep, devices=devices)
-        return cls(dp, sp, pp, ep, tp, num_microbatches, mesh)
+        return cls(dp, sp, pp, ep, tp, num_microbatches, mesh,
+                   tp_overlap=model_cfg.tp_overlap)
 
     def mesh_dict(self) -> dict[str, int]:
         """The result-JSON ``mesh`` field."""

@@ -263,8 +263,12 @@ def write_cp_scaling_report(
         "Ulysses materialises full [S, S] scores per local head.  "
         "`skip (estimated_time)` cells are wall-clock-capped: ring's "
         "total attention compute is Θ(S²) independent of sp "
-        "on a serially-simulated mesh, so at S=32768 one sp degree "
-        "(sp=8) carries the S axis and the rest are logged skips.",
+        "on a serially-simulated mesh.  The measured S axis therefore "
+        "ends at S=16384 (all sp degrees); S=32768 is "
+        "boundary-documented only — the one budget-admitted cell "
+        "(ring sp=8) is the XLA:CPU rendezvous-timeout `infeasible` "
+        "cell recorded in its own artifact, and every Ulysses S=32768 "
+        "cell is footprint-capped.",
         "",
     ]
     from dlbb_tpu.stats.compare import md_table

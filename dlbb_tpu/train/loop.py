@@ -561,6 +561,9 @@ def run_train(
         "pipeline_schedule": pipeline_schedule if plan.pp > 1 else None,
         "remat": model_cfg.remat,
         "remat_policy": model_cfg.remat_policy if model_cfg.remat else None,
+        # TP collective-matmul schedule (off = GSPMD fused; ring/bidir =
+        # overlapped decomposition, docs/overlap.md)
+        "tp_overlap": model_cfg.tp_overlap,
         "compiler_options": comp_opts or None,
         "compile_time_s": compile_time,
         "step_time": summarize(step_times),
@@ -604,8 +607,13 @@ def run_train_from_config(
     zero_stage: Optional[int] = None,
     output_dir: Optional[str] = None,
     devices: Optional[Sequence] = None,
+    tp_overlap: Optional[str] = None,
 ) -> dict[str, Any]:
+    """``tp_overlap`` overrides the config's ``model.tp_overlap`` (the
+    ``--tp-overlap`` CLI flag), mirroring ``run_e2e_from_config``."""
     config = load_config(config_path)
+    if tp_overlap is not None:
+        config.setdefault("model", {})["tp_overlap"] = tp_overlap
     out = output_dir or config.get("experiment", {}).get("output_dir")
     return run_train(config, zero1=zero1, zero_stage=zero_stage,
                      devices=devices, output_dir=out)

@@ -24,4 +24,11 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
 JAX_PLATFORMS=cpu python -m pytest tests/test_bench.py -q \
     -m pipeline_smoke -p no:cacheprovider
 
+# overlapped collective-matmul smoke (docs/overlap.md): tp_overlap
+# ring/bidir forward must match the GSPMD fused path on the simulated
+# dp2 x tp4 mesh (the HLO-side decomposition contract is enforced by the
+# audit above via the overlap targets in the default registry)
+JAX_PLATFORMS=cpu python -m pytest tests/test_collective_matmul.py -q \
+    -m overlap_smoke -p no:cacheprovider
+
 echo "comm-lint: clean (report: $REPORT)"

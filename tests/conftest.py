@@ -41,6 +41,11 @@ def pytest_configure(config):
         "pipeline_smoke: compile-ahead sweep-engine smoke (tier-1; also "
         "invoked standalone by scripts/run_static_analysis.sh)",
     )
+    config.addinivalue_line(
+        "markers",
+        "overlap_smoke: ring-decomposed collective-matmul smoke (tier-1; "
+        "also invoked standalone by scripts/run_static_analysis.sh)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

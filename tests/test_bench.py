@@ -164,6 +164,14 @@ def test_estimate_global_bytes_pinned_per_op():
         "sendrecv": p + p,
         "reducescatter": p * p + p,
         "allreduce_hierarchical": p + p,
+        # collective-matmul micro-ops: per-rank in AND out (ag_matmul's
+        # output is byte-for-byte the input size; matmul_rs's is input/P,
+        # conservatively estimated at the per_rank multiplier) PLUS the
+        # registry-declared transient — the fused ag_matmul materialises
+        # the gathered [B, P*S, H] activation on every device (P^2), the
+        # fused matmul_rs a full per-device partial product (P)
+        "ag_matmul": p + p + p * p,
+        "matmul_rs": p + p + p,
     }
     assert sorted(expected_mults) == sorted(OPERATIONS)  # full coverage
     s = Sweep1D(dtype="float32")
@@ -172,6 +180,16 @@ def test_estimate_global_bytes_pinned_per_op():
             s, {"operation": op_name, "num_elements": n}, p
         )
         assert est == mult * n * itemsize, op_name
+    # the transient term models the FUSED schedule only: under the
+    # overlap variants the decomposed ring never materialises it, so the
+    # estimate drops back to in+out (a fused-sized cap must not skip
+    # ring configs that fit)
+    for op_name in ("ag_matmul", "matmul_rs"):
+        est = _estimate_global_bytes(
+            Sweep1D(dtype="float32", variant="overlap_ring"),
+            {"operation": op_name, "num_elements": n}, p,
+        )
+        assert est == (p + p) * n * itemsize, op_name
 
 
 @pytest.mark.pipeline_smoke

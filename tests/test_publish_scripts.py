@@ -361,3 +361,62 @@ def test_reports_regeneration_is_byte_stable(tmp_path):
         elif not filecmp.cmp(f, committed, shallow=False):
             mismatches.append(f"{f.relative_to(stats_copy)}: differs")
     assert not mismatches, mismatches
+
+
+def _load_baselines():
+    """Import publish_baselines (guarded main; import is side-effect
+    free on the simulated mesh)."""
+    spec = importlib.util.spec_from_file_location(
+        "publish_baselines", REPO / "scripts" / "publish_baselines.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tuning_grid_dedups_full_grid_variants():
+    """ADVICE r5: the reduced tuning grid must not re-run VARIANTS_3D
+    members at the full-grid stage's rank counts (same output dirs,
+    different max_global_bytes -> --fresh artifacts for shared cells
+    would be order-dependent).  Rank counts the full-grid stage does NOT
+    cover (ring @ 16) are kept, and member order is the deterministic
+    input order."""
+    mod = _load_baselines()
+    members = mod._tuning_grid_members(mod.EXECUTABLE_VARIANTS, (4, 8))
+    names = [n for n, _ in members]
+    # no full-grid variant re-measured at full-grid rank counts
+    assert not set(names) & set(mod.VARIANTS_3D), names
+    # "default" excluded, order deterministic (input order)
+    assert "default" not in names
+    expected = [n for n in mod.EXECUTABLE_VARIANTS
+                if n != "default" and n not in mod.VARIANTS_3D]
+    assert names == expected
+    # every surviving member sweeps the full requested rank tuple
+    assert all(ranks == (4, 8) for _, ranks in members)
+    # the 16-rank rung keeps ring: stage_variants3d only covers (4, 8)
+    members16 = mod._tuning_grid_members(mod.VARIANTS_16, (16,))
+    assert ("ring", (16,)) in members16
+
+
+def test_cp_time_skip_reason_wording():
+    """ADVICE r5: the skipped_estimated_time reason must say the measured
+    S axis ends at 16384 and S=32768 is boundary-documented only — not
+    point readers at an sp allowance that produced no measurement."""
+    mod = _load_baselines()
+    reason = mod._cp_time_skip_reason(32768, (8,))
+    assert "boundary-documented only" in reason
+    assert "measured S axis ends at 16384" in reason
+    assert "to carry the S axis" not in reason
+
+
+def test_cp_scaling_report_wording(tmp_path):
+    """The CP_SCALING.md prose must match: no claim that an sp degree
+    'carries the S axis' at S=32768 (that cell is the rendezvous-timeout
+    infeasible cell; all Ulysses S=32768 cells are footprint-capped)."""
+    from dlbb_tpu.stats.parallelism_report import write_cp_scaling_report
+
+    write_cp_scaling_report(tmp_path / "empty", tmp_path / "out")
+    md = (tmp_path / "out" / "CP_SCALING.md").read_text()
+    assert "boundary-documented only" in md
+    assert "carries the S axis" not in md
+    assert "ends at S=16384" in md
