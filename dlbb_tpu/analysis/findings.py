@@ -104,9 +104,11 @@ class AnalysisReport:
         }
 
     def write_json(self, path) -> None:
-        p = Path(path)
-        p.parent.mkdir(parents=True, exist_ok=True)
-        p.write_text(json.dumps(self.to_dict(), indent=2, sort_keys=True))
+        from dlbb_tpu.utils.config import atomic_write_text
+
+        atomic_write_text(
+            json.dumps(self.to_dict(), indent=2, sort_keys=True), Path(path)
+        )
 
     def render_summary(self) -> str:
         lines = []

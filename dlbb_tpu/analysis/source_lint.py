@@ -22,6 +22,13 @@ distributed benchmark repo cares about and generic linters do not:
   a set literal / ``set(...)`` call — hash-order dependent, so publish
   scripts reprocess artifacts in a different order run to run (the
   round-5 ADVICE nondeterminism finding, generalised).
+- ``non-atomic-artifact-write``: a bare ``json.dump(...)`` (in-place
+  write of the destination file) or ``*.write_text(json.dumps(...))``
+  outside the sanctioned atomic helper (``utils/config.py``:
+  ``save_json`` / ``atomic_write_text``, tmp + fsync + ``os.replace``).
+  A process killed mid-dump leaves a truncated JSON at the final path —
+  which resume-mode sweeps and the stats pipeline would then trust
+  (the PR-5 robustness hazard, ``docs/resilience.md``).
 
 Timed regions are detected syntactically: the body of ``with Timer()``
 (also ``with Timer() as t``), and statements strictly between
@@ -53,10 +60,14 @@ LINT_RULES = (
     "missing-donation",
     "jit-in-loop",
     "unsorted-set-iteration",
+    "non-atomic-artifact-write",
 )
 
 # Files whose whole purpose is host synchronisation around measurement.
 TIMING_API_FILES = ("utils/timing.py",)
+# The one sanctioned in-place writer: the atomic helper itself (its
+# json.dump-to-tmp is the mechanism every other writer must go through).
+ATOMIC_API_FILES = ("utils/config.py",)
 # Calls through the sanctioned timing API are never host-sync findings.
 TIMING_API_NAMES = {
     "force_completion", "calibrate_fetch_overhead",
@@ -328,6 +339,58 @@ def _check_jit_in_loop(tree: ast.AST, path: str, findings: list[Finding]):
                 ))
 
 
+def _check_atomic_writes(tree: ast.AST, path: str, findings: list[Finding]):
+    """``non-atomic-artifact-write``: JSON artifacts must go through the
+    atomic helper (tmp + fsync + ``os.replace``), never be written
+    in-place at their final path."""
+
+    def is_dumps(e: ast.AST) -> bool:
+        if isinstance(e, ast.Call) and _call_name(e).rsplit(
+                ".", 1)[-1] == "dumps" and _call_name(e).startswith("json"):
+            return True
+        if isinstance(e, ast.BinOp) and isinstance(e.op, ast.Add):
+            # json.dumps(...) + "\n" and friends
+            return is_dumps(e.left) or is_dumps(e.right)
+        return False
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        if name == "json.dump":
+            findings.append(Finding(
+                pass_name="lint",
+                rule="non-atomic-artifact-write",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    "bare json.dump writes the destination in-place — a "
+                    "process killed mid-dump leaves a truncated artifact "
+                    "that resume-mode sweeps / the stats pipeline would "
+                    "trust; use dlbb_tpu.utils.config.save_json (tmp + "
+                    "fsync + os.replace)"
+                ),
+                location=f"{path}:{node.lineno}",
+                details={"call": "json.dump"},
+            ))
+        elif (name.rsplit(".", 1)[-1] == "write_text" and node.args
+                and is_dumps(node.args[0])):
+            findings.append(Finding(
+                pass_name="lint",
+                rule="non-atomic-artifact-write",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    "write_text(json.dumps(...)) truncates the "
+                    "destination before writing — a kill mid-write tears "
+                    "the artifact; use dlbb_tpu.utils.config.save_json / "
+                    "atomic_write_text (tmp + fsync + os.replace)"
+                ),
+                location=f"{path}:{node.lineno}",
+                details={"call": "write_text(json.dumps)"},
+            ))
+
+
 def _check_set_iteration(tree: ast.AST, path: str, findings: list[Finding]):
     def is_set_expr(e: ast.AST) -> bool:
         if isinstance(e, ast.Set):
@@ -380,6 +443,8 @@ def lint_source(source: str, path: str) -> tuple[list[Finding], int]:
     _check_donation(tree, path, findings)
     _check_jit_in_loop(tree, path, findings)
     _check_set_iteration(tree, path, findings)
+    if not norm.endswith(ATOMIC_API_FILES):
+        _check_atomic_writes(tree, path, findings)
 
     sup = Suppressions(source)
     kept = []

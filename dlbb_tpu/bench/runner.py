@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import signal
 import threading
 import time
 import traceback
@@ -46,6 +47,19 @@ from dlbb_tpu.comm.ops import (
     payload_cache_key,
 )
 from dlbb_tpu.comm.variants import Variant, get_variant
+from dlbb_tpu.resilience import inject
+from dlbb_tpu.resilience.errors import (
+    CorruptStats,
+    DeadlineExceeded,
+    exception_chain,
+    is_transient,
+)
+from dlbb_tpu.resilience.journal import SweepJournal
+from dlbb_tpu.resilience.preempt import PreemptionGuard
+from dlbb_tpu.resilience.validate import (
+    validate_result_json,
+    validate_timings,
+)
 from dlbb_tpu.utils.config import save_json
 from dlbb_tpu.utils.sysinfo import collect_system_info
 from dlbb_tpu.utils.timing import resolve_timing_mode, time_collective
@@ -117,9 +131,11 @@ class Sweep1D:
     # skip configs whose estimated global input+output footprint exceeds
     # this (host-simulated meshes hold every shard in one RAM pool)
     max_global_bytes: Optional[int] = None
-    # skip configs whose result JSON already exists in output_dir — lets an
-    # interrupted sweep (time-budgeted publisher runs) pick up where it left
-    # off instead of re-measuring the whole grid
+    # skip configs whose result JSON already exists AND validates (parse +
+    # finite stats, dlbb_tpu.resilience.validate) in output_dir — lets an
+    # interrupted sweep (time-budgeted publisher runs, preemptions) pick up
+    # where it left off instead of re-measuring the whole grid; an invalid
+    # existing artifact (torn write) is re-measured with a warning
     resume: bool = False
     # pipelined execution engine (dlbb_tpu.bench.schedule): compile config
     # N+1..N+prefetch on a background thread between measurements.
@@ -132,6 +148,20 @@ class Sweep1D:
     # explicit directory, or None/"off" to disable (DLBB_XLA_CACHE env
     # overrides either way)
     compile_cache: Optional[str] = "auto"
+    # --- resilience knobs (docs/resilience.md) ---------------------------
+    # fault-injection plan spec (dlbb_tpu.resilience.inject grammar);
+    # None = DLBB_FAULT_PLAN env (itself usually unset -> no injection)
+    fault_plan: Optional[str] = None
+    # wall-clock watchdog per work unit, covering both the background
+    # compile and the measurement: an overrun is abandoned + quarantined,
+    # never blocks the pipeline drain (DLBB_UNIT_DEADLINE env default)
+    unit_deadline_seconds: Optional[float] = None
+    # bounded retry with exponential backoff for transient failures;
+    # retried configs recompute from scratch and carry `retries: N`
+    max_retries: int = 2
+    retry_backoff_seconds: float = 0.05
+    # append-only crash-safe sweep_journal.jsonl next to the artifacts
+    journal: bool = True
 
     kind: str = "1d"
 
@@ -160,6 +190,12 @@ class Sweep3D:
     pipeline: Optional[bool] = None
     prefetch: int = 2
     compile_cache: Optional[str] = "auto"
+    # resilience knobs — see Sweep1D / docs/resilience.md
+    fault_plan: Optional[str] = None
+    unit_deadline_seconds: Optional[float] = None
+    max_retries: int = 2
+    retry_backoff_seconds: float = 0.05
+    journal: bool = True
 
     kind: str = "3d"
 
@@ -321,9 +357,16 @@ def run_sweep(
     configs that share them; a ``sweep_manifest.json`` with wall/compile
     totals lands next to the artifacts.
 
-    Per-config failures — compile failures included — are caught,
-    reported, and skipped so one failing combination doesn't kill the
-    sweep (reference ``collectives/1d/openmpi.py:253-267``).
+    Per-config failures — compile failures included — are contained:
+    transient ones retry with exponential backoff (recomputing from
+    scratch; the artifact records ``retries``), permanent ones are
+    QUARANTINED — journaled ``failed`` with the exception chain in
+    ``sweep_manifest.json`` — never silently skipped (hardened version of
+    reference ``collectives/1d/openmpi.py:253-267``).  A per-unit
+    wall-clock deadline (``unit_deadline_seconds``) watchdogs both the
+    background compile and the measurement; SIGTERM lands as a graceful
+    journaled stop a ``--resume`` run completes exactly
+    (docs/resilience.md).
     """
     variant = get_variant(sweep.variant)
     _check_variant_flags(variant)
@@ -335,35 +378,125 @@ def run_sweep(
     t_sweep0 = time.perf_counter()
     mode = resolve_timing_mode(sweep.timing_mode)
 
+    # chaos-harness activation: an explicit sweep.fault_plan wins; else an
+    # already-active plan (embedding harness) is left alone; else the env
+    fault_spec = sweep.fault_plan
+    if fault_spec is None and inject.active() is None:
+        fault_spec = os.environ.get(inject.ENV_VAR, "").strip() or None
+
     # everything from here — planning included — runs with the persistent
     # compilation cache scoped to this sweep; the finally guarantees no
     # later non-sweep compile ever sees it (see
     # schedule.deactivate_compilation_cache)
     cache_dir = schedule.configure_compilation_cache(sweep.compile_cache)
     try:
-        return _run_sweep_configured(
-            sweep, variant, impl, out_dir, written, sysinfo, n_avail,
-            devices, mode, cache_dir, t_sweep0, verbose,
-        )
+        with inject.plan_scope(fault_spec), PreemptionGuard() as guard:
+            return _run_sweep_configured(
+                sweep, variant, impl, out_dir, written, sysinfo, n_avail,
+                devices, mode, cache_dir, t_sweep0, verbose, guard,
+            )
     finally:
         schedule.deactivate_compilation_cache()
 
 
+def _collective_stop(requested: bool) -> bool:
+    """Pod-uniform preemption decision: ANY host's SIGTERM stops every
+    host at the same config boundary.  Called by every process for every
+    config in the same order (like ``_resume_ok``), so the allgather
+    schedule stays uniform — a per-host stop would send the surviving
+    hosts into the next config's SPMD collective alone and hang the pod."""
+    if jax.process_count() == 1:
+        return requested
+    from jax.experimental import multihost_utils
+
+    bits = multihost_utils.process_allgather(
+        np.asarray([requested], dtype=np.int32)
+    )
+    return bool(np.asarray(bits).any())
+
+
+def _resolve_deadline(sweep) -> Optional[float]:
+    """Per-work-unit wall-clock deadline: sweep field, else
+    ``DLBB_UNIT_DEADLINE`` env, else off."""
+    if sweep.unit_deadline_seconds is not None:
+        return float(sweep.unit_deadline_seconds)
+    env = os.environ.get("DLBB_UNIT_DEADLINE", "").strip()
+    return float(env) if env else None
+
+
+def _call_with_deadline(fn, deadline: Optional[float], label: str,
+                        gate) -> Any:
+    """Run ``fn`` under the measurement watchdog.
+
+    With no deadline this is a direct call (zero threads, zero overhead).
+    With one, ``fn(cancel)`` runs on a daemon thread joined for
+    ``deadline`` seconds; an overrun ABANDONS the thread (it cannot be
+    killed — it may be wedged inside a C extension), sets the ``cancel``
+    event so the zombie — if it ever wakes — suppresses its artifact
+    write (``_run_one`` checks it immediately before ``save_json``: a
+    quarantined config must never be resurrected on disk by a thread the
+    manifest says failed), degrades the measurement gate so the zombie
+    can never block later configs or the compile worker, and raises
+    :class:`DeadlineExceeded` for the quarantine path."""
+    if deadline is None:
+        return fn(None)
+    box: dict[str, Any] = {}
+    cancel = threading.Event()
+
+    def target() -> None:
+        try:
+            box["value"] = fn(cancel)
+        except BaseException as e:  # noqa: BLE001 — marshalled to caller
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"dlbb-measure-{label}")
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        cancel.set()
+        if gate is not None and hasattr(gate, "degrade"):
+            gate.degrade()
+        raise DeadlineExceeded(label, deadline, phase="measure")
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
 def _run_sweep_configured(
     sweep, variant, impl, out_dir, written, sysinfo, n_avail, devices,
-    mode, cache_dir, t_sweep0, verbose,
+    mode, cache_dir, t_sweep0, verbose, guard: Optional[PreemptionGuard],
 ) -> list[Path]:
+    journal = SweepJournal(
+        out_dir,
+        meta={"kind": sweep.kind, "implementation": impl,
+              "variant": variant.name, "resume": sweep.resume,
+              "fault_plan": getattr(inject.active(), "spec", None)},
+        # multi-host: every process walks the same grid in the same order
+        # (collective resume decisions), so one journal — the
+        # coordinator's — records the run; per-host journals on a shared
+        # filesystem would interleave duplicate lines
+        enabled=sweep.journal and jax.process_index() == 0,
+    )
     # ---- planning pass -------------------------------------------------
     plan: list[_Planned] = []
     units: "dict[tuple, schedule.WorkUnit]" = {}
     # every counter counts CONFIGS (a skipped rank count skips one whole
     # grid of them), so planned+skipped+resumed+failed adds up
+    # (resume_invalid configs re-run, so they also land in
+    # measured/failed — the counter is informational)
     grid_size = sum(1 for _ in _iter_configs(sweep))
-    counts = {"resumed": 0, "skipped_mem": 0, "skipped_ranks": 0,
-              "measured": 0, "failed": 0}
+    counts = {"resumed": 0, "resume_invalid": 0, "skipped_mem": 0,
+              "skipped_ranks": 0, "measured": 0, "failed": 0}
+    quarantined: list[dict[str, Any]] = []
+    retries_total = 0
+    abandoned_measurements = 0
+    preempted = False
     for num_ranks in sweep.rank_counts:
         if num_ranks > n_avail:
             counts["skipped_ranks"] += grid_size
+            journal.event("rank-skip", num_ranks=num_ranks,
+                          reason=f"{num_ranks} ranks > {n_avail} devices")
             if verbose:
                 print(
                     f"[skip] {num_ranks} ranks > {n_avail} devices available"
@@ -377,11 +510,13 @@ def _run_sweep_configured(
             # count — skip this rank count, keep sweeping (parity with the
             # reference's per-config error-skip, collectives/1d/openmpi.py:253)
             counts["skipped_ranks"] += grid_size
+            journal.event("rank-skip", num_ranks=num_ranks, reason=str(e))
             if verbose:
                 print(f"[skip] ranks={num_ranks}: {e}")
             continue
         axes = spec.axis_names
         for config in _iter_configs(sweep):
+            fname = _result_filename(sweep, impl, num_ranks, config)
             # per-config containment covers the WHOLE planning of a config
             # (mem estimate included — it resolves the op name too): e.g.
             # an unknown op skips that config and keeps sweeping, exactly
@@ -391,6 +526,9 @@ def _run_sweep_configured(
                     est = _estimate_global_bytes(sweep, config, num_ranks)
                     if est > sweep.max_global_bytes:
                         counts["skipped_mem"] += 1
+                        journal.event("skipped", config=fname,
+                                      reason="memory-cap",
+                                      estimated_bytes=est)
                         if verbose:
                             print(
                                 f"[skip-mem] {config['operation']} ranks="
@@ -400,21 +538,36 @@ def _run_sweep_configured(
                             )
                         continue
                 if sweep.resume:
-                    existing = out_dir / _result_filename(
-                        sweep, impl, num_ranks, config
-                    )
-                    if _resume_exists(existing):
+                    existing = out_dir / fname
+                    ok, why = _resume_ok(existing)
+                    if ok:
                         counts["resumed"] += 1
+                        journal.event("resume-valid", config=fname)
                         if verbose:
                             print(f"  [resume-skip] {existing.name}")
                         written.append(existing)
                         continue
+                    if why != "missing":
+                        # died-mid-write / corrupt artifact: NEVER trust
+                        # it — re-measure (atomic overwrite) with a
+                        # durable record of why
+                        counts["resume_invalid"] += 1
+                        journal.event("resume-invalid", config=fname,
+                                      reason=why)
+                        if verbose:
+                            print(f"  [resume-INVALID] {existing.name}: "
+                                  f"{why} — re-measuring")
                 plan.append(_plan_config(
                     sweep, variant, mesh, axes, num_ranks, config, units,
                     mode,
                 ))
+                journal.event("planned", config=fname)
             except Exception as e:  # noqa: BLE001 — per-config containment
                 counts["failed"] += 1
+                quarantined.append({"config": fname, "phase": "planning",
+                                    "retries": 0, **exception_chain(e)})
+                journal.event("failed", config=fname, phase="planning",
+                              error=str(e))
                 if verbose:
                     print(f"[error] {impl} {config}: planning failed: {e}")
                 continue
@@ -425,7 +578,7 @@ def _run_sweep_configured(
     # with cores to spare
     measure_gate = (
         None if os.environ.get("DLBB_COMPILE_OVERLAP") == "1"
-        else threading.Lock()
+        else schedule.MeasureGate()
     )
     pipeline = (sweep.pipeline if sweep.pipeline is not None
                 else schedule.default_pipeline())
@@ -434,28 +587,127 @@ def _run_sweep_configured(
         measure_gate=measure_gate,
     )
     payloads = schedule.PayloadCache()
+    deadline = _resolve_deadline(sweep)
+    if deadline is not None and jax.process_count() > 1:
+        # a per-host abandon cannot be coordinated through a hung SPMD
+        # collective (the other hosts are stuck inside it), and letting
+        # one host quarantine + move on desynchronizes the pod's
+        # collective schedule — the exact hang _resume_ok's allgather
+        # exists to prevent.  The watchdog is single-process semantics;
+        # disable it loudly on pods.
+        journal.event("watchdog-disabled",
+                      reason="multi-host run: per-host abandonment would "
+                             "desynchronize the SPMD schedule")
+        if verbose:
+            print("[watchdog] unit deadline disabled: multi-host run "
+                  "(per-host abandonment would desynchronize the pod)")
+        deadline = None
+    attempts = max(0, int(sweep.max_retries)) + 1
     scheduler.start()
     try:
         for entry in plan:
-            unit = scheduler.get(entry.unit)
+            fname = _result_filename(sweep, impl, entry.num_ranks,
+                                     entry.config)
+            if inject.fire("preempt"):
+                # chaos harness: deliver a real SIGTERM to ourselves —
+                # the PreemptionGuard turns it into the flag below
+                os.kill(os.getpid(), signal.SIGTERM)
+            if _collective_stop(guard is not None and guard.requested):
+                preempted = True
+                journal.event("preempted", config=fname,
+                              signal=guard.signal_received)
+                if verbose:
+                    print(f"[preempt] SIGTERM received — stopping before "
+                          f"{fname}; journal flushed, resume completes "
+                          "the grid")
+                break
+            try:
+                unit = scheduler.get(entry.unit, deadline=deadline)
+            except DeadlineExceeded as e:
+                counts["failed"] += 1
+                quarantined.append({
+                    "config": fname, "label": entry.unit.label,
+                    "phase": "compile", "retries": 0,
+                    **exception_chain(e),
+                })
+                journal.event("failed", config=fname, phase="compile",
+                              error=str(e))
+                if verbose:
+                    print(f"[watchdog] {impl} {fname}: {e}")
+                continue
             if unit.error is not None:
                 counts["failed"] += 1
+                quarantined.append({
+                    "config": fname, "label": unit.label,
+                    "phase": "compile", "retries": 0,
+                    **exception_chain(unit.error),
+                })
+                journal.event("failed", config=fname, phase="compile",
+                              error=str(unit.error))
                 if verbose:
                     print(f"[error] {impl} {entry.config}: compile failed "
                           f"for {unit.label}: {unit.error}")
                 continue
-            try:
-                path = _run_one(
-                    sweep, variant, impl, entry, out_dir, sysinfo, verbose,
-                    mode=mode, payloads=payloads, measure_gate=measure_gate,
-                )
-                written.append(path)
-                counts["measured"] += 1
-            except Exception as e:  # noqa: BLE001 — sweep resilience
+            journal.event("started", config=fname)
+            last_exc: Optional[BaseException] = None
+            attempt = 0
+            for attempt in range(attempts):
+                try:
+                    path = _call_with_deadline(
+                        lambda cancel: _run_one(
+                            sweep, variant, impl, entry, out_dir, sysinfo,
+                            verbose, mode=mode, payloads=payloads,
+                            measure_gate=measure_gate, retries=attempt,
+                            unit=unit, cancel=cancel,
+                        ),
+                        deadline, unit.label, measure_gate,
+                    )
+                    written.append(path)
+                    counts["measured"] += 1
+                    retries_total += attempt
+                    journal.event("completed", config=fname,
+                                  retries=attempt)
+                    last_exc = None
+                    break
+                except DeadlineExceeded as e:
+                    # a hang is not transient: the zombie thread still
+                    # owns the payload cache (and possibly the gate) —
+                    # hand later configs a fresh cache and quarantine
+                    abandoned_measurements += 1
+                    payloads = schedule.PayloadCache()
+                    last_exc = e
+                    break
+                except Exception as e:  # noqa: BLE001 — sweep resilience
+                    payloads.invalidate(entry.payload_key)
+                    last_exc = e
+                    if is_transient(e) and attempt < attempts - 1:
+                        delay = (sweep.retry_backoff_seconds
+                                 * (2 ** attempt))
+                        journal.event("retry", config=fname,
+                                      attempt=attempt + 1, error=str(e),
+                                      backoff_seconds=delay)
+                        if verbose:
+                            print(f"[retry] {impl} {fname}: transient "
+                                  f"{type(e).__name__}: {e} — backing off "
+                                  f"{delay:.3f}s (attempt "
+                                  f"{attempt + 1}/{attempts - 1})")
+                        time.sleep(delay)
+                        continue
+                    break
+            if last_exc is not None:
                 counts["failed"] += 1
+                quarantined.append({
+                    "config": fname, "label": unit.label,
+                    "phase": "measure", "retries": attempt,
+                    **exception_chain(last_exc),
+                })
+                journal.event("failed", config=fname, phase="measure",
+                              retries=attempt, error=str(last_exc))
                 if verbose:
-                    print(f"[error] {impl} {entry.config}: {e}")
-                    traceback.print_exc()
+                    print(f"[error] {impl} {entry.config}: {last_exc}")
+                    traceback.print_exception(
+                        type(last_exc), last_exc, last_exc.__traceback__
+                    )
                 continue
     finally:
         scheduler.close()
@@ -493,8 +745,27 @@ def _run_sweep_configured(
             },
             "configs": dict(counts),
             "payload_cache": payloads.stats(),
+            "resilience": {
+                "fault_plan": getattr(inject.active(), "spec", None),
+                "unit_deadline_seconds": deadline,
+                "max_retries": sweep.max_retries,
+                "retries_total": retries_total,
+                "quarantined": quarantined,
+                "preempted": preempted,
+                "watchdog": {
+                    "abandoned_measurements": abandoned_measurements,
+                    "abandoned_compiles": scheduler.abandoned,
+                    "scheduler_wedged": scheduler.wedged,
+                    "gate_degraded": bool(
+                        getattr(measure_gate, "degraded", False)
+                    ),
+                },
+            },
             "timestamp": time.time(),
         })
+    journal.event("sweep-end", preempted=preempted,
+                  measured=counts["measured"], failed=counts["failed"])
+    journal.close()
     return written
 
 
@@ -547,8 +818,13 @@ def _iter_configs(sweep):
                         }
 
 
-def _resume_exists(path: Path) -> bool:
-    """Whether a resume-mode sweep may skip this config.
+def _resume_ok(path: Path) -> tuple[bool, str]:
+    """Whether a resume-mode sweep may skip this config, and why not.
+
+    Existence is NOT enough: a process killed mid-write (or a torn legacy
+    artifact) must be re-measured, so the existing JSON is validated —
+    parses, carries the result schema, all timings finite
+    (``dlbb_tpu.resilience.validate``) — before resume trusts it.
 
     Multi-host runs decide collectively: hosts have non-shared disks, and a
     run killed between one host's ``save_json`` and another's would leave
@@ -556,17 +832,20 @@ def _resume_exists(path: Path) -> bool:
     config's SPMD collective while others skip it, hanging the pod.  Every
     process calls this for every candidate config in the same order, so the
     allgather schedule stays uniform; the config re-runs everywhere unless
-    ALL hosts already hold the artifact (re-measuring on the hosts that had
-    it just atomically overwrites)."""
-    exists = path.exists()
+    ALL hosts already hold a VALID artifact (re-measuring on the hosts that
+    had it just atomically overwrites)."""
+    ok, why = validate_result_json(path)
     if jax.process_count() == 1:
-        return exists
+        return ok, why
     from jax.experimental import multihost_utils
 
     bits = multihost_utils.process_allgather(
-        np.asarray([exists], dtype=np.int32)
+        np.asarray([ok], dtype=np.int32)
     )
-    return bool(np.asarray(bits).all())
+    all_ok = bool(np.asarray(bits).all())
+    if ok and not all_ok:
+        why = "valid here but invalid/missing on another host"
+    return all_ok, why
 
 
 # filename tags for non-default dtypes: the bf16 corpus keeps the original
@@ -590,10 +869,17 @@ def _result_filename(sweep, impl: str, num_ranks: int, config) -> str:
 def _run_one(
     sweep, variant, impl, planned: _Planned, out_dir, sysinfo, verbose,
     *, mode: str, payloads: schedule.PayloadCache,
-    measure_gate: Optional[threading.Lock] = None,
+    measure_gate=None, retries: int = 0,
+    unit: Optional[schedule.WorkUnit] = None,
+    cancel: Optional[threading.Event] = None,
 ) -> Path:
     mesh, axes = planned.mesh, planned.axes
-    num_ranks, config, unit = planned.num_ranks, planned.config, planned.unit
+    num_ranks, config = planned.num_ranks, planned.config
+    # the unit the SCHEDULER resolved: normally planned.unit itself, but
+    # after a wedged compile worker it is a fresh inline-compiled clone
+    # (schedule.CompileAheadScheduler.get) — never read planned.unit here
+    if unit is None:
+        unit = planned.unit
     op_name = config["operation"]
     op = get_op(op_name)
     dtype = _dtype_of(sweep.dtype)
@@ -613,6 +899,16 @@ def _run_one(
          else payloads.get(planned.payload_key, build_payload))
     fn = unit.fn
     chain = op.make_chain(num_ranks) if op.make_chain is not None else None
+
+    # chaos-harness sites, strictly BEFORE the timed region (zero
+    # instructions inside it; see dlbb_tpu/resilience/inject.py)
+    if inject.fire("exec-transient"):
+        payloads.invalidate(planned.payload_key)
+        raise inject.TransientFault(
+            f"injected transient runtime failure for {unit.label}"
+        )
+    if inject.fire("exec-hang"):
+        time.sleep(inject.param("hang_seconds"))
 
     # holding the gate keeps the compile-ahead worker out of the timed
     # region — background compilation contends for the host cores the
@@ -643,7 +939,22 @@ def _run_one(
     if timing_meta.get("timing_mode") == "chained" and mode != "chained":
         # the per-iter plausibility fallback donated the (cached) payload
         payloads.invalidate(planned.payload_key)
+    if inject.fire("stats-nan"):
+        # chaos harness: poison the timing vector AFTER the timed region —
+        # the pre-write validation below must refuse to publish it
+        local = list(local)
+        local[0] = float("nan")
+        if len(local) > 1:
+            local[-1] = float("inf")
     timings = _gather_timings(local)
+    ok, why = validate_timings(timings)
+    if not ok:
+        # NaN/Inf must never reach an artifact; CorruptStats is transient
+        # so the retry loop re-measures from scratch
+        payloads.invalidate(planned.payload_key)
+        raise CorruptStats(
+            f"{unit.label}: {why} — refusing to write the artifact"
+        )
 
     # the first config that WRITES an artifact reports the compile its
     # work unit paid for (see WorkUnit.compile_reported); later sharers
@@ -667,6 +978,10 @@ def _run_one(
         # (in-process work-unit dedup or the persistent XLA cache)
         "compile_seconds": compile_seconds,
         "compile_cache_hit": compile_cache_hit,
+        # transient-failure retries this config burned before succeeding
+        # (0 = first attempt measured clean); retried attempts recompute
+        # from scratch, so nothing of a failed attempt is in `timings`
+        "retries": retries,
         **timing_meta,
         "timings": timings,
         "variant": variant.name,
@@ -688,6 +1003,13 @@ def _run_one(
         result["tensor_size_bytes"] = tensor_size_bytes
         result["tensor_size_mb"] = tensor_size_bytes / 2**20
 
+    if cancel is not None and cancel.is_set():
+        # the watchdog abandoned this thread and QUARANTINED the config —
+        # a late-waking zombie must not resurrect it on disk (resume and
+        # the stats pipeline would trust an artifact measured concurrently
+        # with later configs, contradicting the manifest's failed record)
+        raise DeadlineExceeded(unit.label, 0.0, phase="measure (zombie "
+                               "write suppressed after abandonment)")
     fname = _result_filename(sweep, impl, num_ranks, config)
     path = save_json(result, out_dir / fname)
     unit.compile_reported = True

@@ -46,6 +46,7 @@ changed code path.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -57,6 +58,8 @@ from typing import Any, Callable, Iterable, Optional, Sequence
 import jax
 
 from dlbb_tpu.comm.ops import CollectiveOp, payload_aval
+from dlbb_tpu.resilience import inject
+from dlbb_tpu.resilience.errors import DeadlineExceeded, InjectedFault
 from dlbb_tpu.utils.timing import build_chained_loop, chained_chunk_size
 
 # ---------------------------------------------------------------------------
@@ -316,15 +319,27 @@ class WorkUnit:
     ready: threading.Event = field(default_factory=threading.Event)
 
 
-def _compile_unit(unit: WorkUnit) -> None:
+def _compile_unit(unit: WorkUnit, locked: bool = True) -> None:
     """Trace + lower + compile one unit; idempotent; never raises (build
     failures are contained in ``unit.error`` so one poisoned unit skips its
-    configs while the pipeline drains)."""
+    configs while the pipeline drains).
+
+    ``locked=False`` skips :data:`_COMPILE_LOCK` — only for the
+    wedged-worker fallback (:meth:`CompileAheadScheduler.get`), where the
+    zombie worker holds the lock inside a hung compile forever; the cost
+    is per-unit persistent-cache-hit attribution for that compile, never
+    correctness."""
     if unit.ready.is_set():
         return
     try:
         CACHE_EVENTS.ensure_registered()
-        with _COMPILE_LOCK:
+        if inject.fire("compile-fail"):
+            raise InjectedFault(f"injected compile failure for {unit.label}")
+        if inject.fire("compile-hang"):
+            # models a wedged XLA compile: the watchdog (deadline-aware
+            # get()) must abandon + quarantine without blocking the drain
+            time.sleep(inject.param("hang_seconds"))
+        with _COMPILE_LOCK if locked else contextlib.nullcontext():
             hits0, misses0 = CACHE_EVENTS.snapshot()
             t0 = time.perf_counter()
             unit.fn, unit.executable = unit.build()
@@ -405,6 +420,60 @@ def plan_collective_unit(
 
 
 # ---------------------------------------------------------------------------
+# measurement gate
+# ---------------------------------------------------------------------------
+
+
+class MeasureGate:
+    """The measurement-honesty mutex between timed regions and background
+    compiles — a ``threading.Lock`` with two resilience affordances:
+
+    - **timeout acquisition** (:meth:`acquire`): the compile worker polls
+      instead of blocking forever, so a measurement thread abandoned by
+      the watchdog while holding the gate can never wedge the pipeline
+      drain;
+    - **degraded mode** (:meth:`degrade`): once the watchdog has
+      abandoned a hung unit, the gate may be held by a zombie thread for
+      an unbounded time.  Rather than stalling every remaining config
+      behind it, acquisition falls through ungated after a bounded wait.
+      Degradation is one-way and recorded in the sweep manifest
+      (``watchdog.gate_degraded``) — the measurement-honesty claim of
+      post-hang configs is weakened (a zombie may still be doing device
+      work) and the artifact trail says so.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.degraded = False
+        self._held_here = threading.local()
+
+    def degrade(self) -> None:
+        self.degraded = True
+
+    def acquire(self, timeout: float = 0.25) -> bool:
+        return self._lock.acquire(timeout=timeout)
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "MeasureGate":
+        # bounded wait once degraded; patient (but interruptible-by-
+        # degradation) wait otherwise
+        while True:
+            if self._lock.acquire(timeout=0.25):
+                self._held_here.held = True
+                return self
+            if self.degraded:
+                self._held_here.held = False
+                return self
+
+    def __exit__(self, *exc) -> None:
+        if getattr(self._held_here, "held", False):
+            self._held_here.held = False
+            self._lock.release()
+
+
+# ---------------------------------------------------------------------------
 # compile-ahead scheduler
 # ---------------------------------------------------------------------------
 
@@ -425,7 +494,7 @@ class CompileAheadScheduler:
         units: Iterable[WorkUnit],
         prefetch: int = 2,
         pipeline: bool = True,
-        measure_gate: Optional[threading.Lock] = None,
+        measure_gate: "Optional[MeasureGate | threading.Lock]" = None,
     ) -> None:
         self._units = list(units)
         self._pipeline = bool(pipeline) and bool(self._units)
@@ -443,6 +512,14 @@ class CompileAheadScheduler:
         # ``DLBB_COMPILE_OVERLAP=1`` disables the gate for hosts with
         # cores to spare.
         self._measure_gate = measure_gate
+        # watchdog state: a deadline overrun abandoned a compile — the
+        # worker thread may be permanently stuck inside it
+        self.wedged = False
+        self.abandoned = 0
+        # unit keys whose compile already blew a deadline: NEVER re-run
+        # those builds inline (a deterministically hanging build would
+        # hang the consumer thread, where no watchdog applies)
+        self._abandoned_keys: set[tuple] = set()
 
     @property
     def pipelined(self) -> bool:
@@ -456,6 +533,21 @@ class CompileAheadScheduler:
         )
         self._thread.start()
 
+    def _acquire_gate(self) -> bool:
+        """Poll the gate with stop/degradation checks — an abandoned
+        measurement thread holding the gate must never wedge the drain.
+        Returns whether the gate is actually held (False = proceed
+        ungated: stopping, or gate degraded by the watchdog)."""
+        gate = self._measure_gate
+        if gate is None:
+            return False
+        while not self._stop.is_set():
+            if gate.acquire(timeout=0.25):
+                return True
+            if getattr(gate, "degraded", False):
+                return False
+        return False
+
     def _worker(self) -> None:
         try:
             for unit in self._units:
@@ -464,12 +556,13 @@ class CompileAheadScheduler:
                 self._slots.acquire()
                 if self._stop.is_set():
                     break
-                if self._measure_gate is not None:
-                    with self._measure_gate:
-                        if not self._stop.is_set():
-                            _compile_unit(unit)
-                else:
-                    _compile_unit(unit)
+                held = self._acquire_gate()
+                try:
+                    if not self._stop.is_set():
+                        _compile_unit(unit)
+                finally:
+                    if held:
+                        self._measure_gate.release()
         finally:
             # a unit left un-ready would hang get() forever — fail closed
             for unit in self._units:
@@ -480,13 +573,53 @@ class CompileAheadScheduler:
                     )
                     unit.ready.set()
 
-    def get(self, unit: WorkUnit) -> WorkUnit:
+    def get(self, unit: WorkUnit,
+            deadline: Optional[float] = None) -> WorkUnit:
         """Block until ``unit`` is compiled (or failed); inline-compile in
-        serial mode.  Call once per consuming config."""
+        serial mode.  Call once per consuming config.
+
+        ``deadline`` (pipelined mode only) is the watchdog: a compile
+        still not ready after that many seconds raises
+        :class:`~dlbb_tpu.resilience.errors.DeadlineExceeded`, marks the
+        scheduler wedged, and degrades the measurement gate — the hung
+        compile is abandoned on its daemon thread, never joined.  After a
+        wedge, later units compile inline on the consumer thread (the
+        zombie worker still holds :data:`_COMPILE_LOCK`, so the inline
+        path skips it and forfeits cache-hit attribution, not
+        correctness).  A serial (``pipeline=False``) scheduler compiles
+        on the calling thread, where a hung compile cannot be abandoned —
+        the deadline only covers what runs on the worker."""
         if not self._pipeline:
             _compile_unit(unit)
+        elif self.wedged and not unit.ready.is_set():
+            if unit.key in self._abandoned_keys:
+                # this exact build already blew the deadline once —
+                # re-running it inline would hang the consumer thread
+                # (every config sharing the unit quarantines instead)
+                raise DeadlineExceeded(
+                    unit.label or str(unit.key), float(deadline or 0.0),
+                    phase="compile (unit previously abandoned)",
+                )
+            clone = WorkUnit(
+                key=unit.key, build=unit.build,
+                label=f"{unit.label}/inline-after-wedge",
+                chained=unit.chained,
+            )
+            _compile_unit(clone, locked=False)
+            clone.consumers += 1
+            return clone
         else:
-            unit.ready.wait()
+            if not unit.ready.wait(deadline):
+                self.wedged = True
+                self.abandoned += 1
+                self._abandoned_keys.add(unit.key)
+                gate = self._measure_gate
+                if gate is not None and hasattr(gate, "degrade"):
+                    gate.degrade()
+                raise DeadlineExceeded(
+                    unit.label or str(unit.key), float(deadline or 0.0),
+                    phase="compile",
+                )
             if unit.consumers == 0:
                 self._slots.release()
         unit.consumers += 1
@@ -496,12 +629,22 @@ class CompileAheadScheduler:
         self._stop.set()
         self._slots.release()  # unblock a worker waiting for a slot
         if self._thread is not None:
-            # join WITHOUT timeout: run_sweep's finally resets the
-            # process-wide persistent-cache config right after close(),
-            # and doing that while a compile is still in flight races its
-            # cache write (serial mode would be equally stuck inside the
-            # same wedged compile, so no liveness is lost by waiting)
-            self._thread.join()
+            if self.wedged:
+                # the worker may be stuck inside an abandoned compile
+                # forever; bounded join, then leave the daemon thread
+                # behind (recorded in the manifest via `wedged`).  The
+                # cache-config reset that follows in run_sweep's finally
+                # can race the zombie's eventual cache write — accepted:
+                # the alternative is a sweep that never returns.
+                self._thread.join(timeout=5.0)
+            else:
+                # join WITHOUT timeout: run_sweep's finally resets the
+                # process-wide persistent-cache config right after
+                # close(), and doing that while a compile is still in
+                # flight races its cache write (serial mode would be
+                # equally stuck inside the same wedged compile, so no
+                # liveness is lost by waiting)
+                self._thread.join()
             self._thread = None
 
 

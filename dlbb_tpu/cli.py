@@ -46,6 +46,24 @@ def _add_common(p: argparse.ArgumentParser) -> None:
                         "('auto' = results/.xla_cache relative to the CWD, "
                         "like every other default path here; 'off' "
                         "disables; DLBB_XLA_CACHE env overrides)")
+    p.add_argument("--fault-plan", default=None, metavar="PLAN",
+                   help="deterministic fault-injection plan (chaos "
+                        "harness, e.g. 'exec-transient:2,seed=7'; "
+                        "DLBB_FAULT_PLAN env is the default; see "
+                        "docs/resilience.md)")
+    p.add_argument("--deadline", type=float, default=None, metavar="SEC",
+                   dest="unit_deadline",
+                   help="wall-clock watchdog per work unit (compile + "
+                        "measurement); an overrun is abandoned and "
+                        "quarantined (DLBB_UNIT_DEADLINE env default)")
+    p.add_argument("--max-retries", type=int, default=2, metavar="N",
+                   help="bounded retries with exponential backoff for "
+                        "transient per-config failures (default 2; "
+                        "retried configs recompute from scratch and "
+                        "record `retries` in the artifact)")
+    p.add_argument("--no-journal", action="store_true",
+                   help="disable the append-only sweep_journal.jsonl "
+                        "(crash audit trail; on by default)")
     _add_trace(p)
 
 
@@ -136,6 +154,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="repo root for the source lint (default: cwd)")
     an.add_argument("--strict-warnings", action="store_true",
                     help="exit nonzero on warnings too")
+
+    ch = sub.add_parser(
+        "chaos",
+        help="chaos gate: mini-sweep/mini-train under each injected fault "
+             "class, asserting the resilience invariants (no corrupt "
+             "artifact survives, resume completes the grid, hangs are "
+             "quarantined — docs/resilience.md)",
+    )
+    ch.add_argument("--plan", default="all",
+                    help="fault class to exercise (compile, transient, "
+                         "nan, torn, hang, ckpt, preempt, kill) or 'all'")
+    ch.add_argument("--simulate", type=int, default=8, metavar="N",
+                    help="CPU-simulated mesh size (default 8; the gate "
+                         "needs no TPU)")
+    ch.add_argument("--output", default=None,
+                    help="workdir for the gate's artifacts (default: a "
+                         "fresh temp dir, kept on failure)")
 
     tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
@@ -243,6 +278,10 @@ def _dispatch(args) -> int:
             pipeline=_pipeline_arg(args),
             prefetch=args.prefetch,
             compile_cache=args.compile_cache,
+            fault_plan=args.fault_plan,
+            unit_deadline_seconds=args.unit_deadline,
+            max_retries=args.max_retries,
+            journal=not args.no_journal,
         )
         files = run_sweep(sweep)
         # resume mode counts pre-existing artifacts too — don't claim writes
@@ -268,6 +307,10 @@ def _dispatch(args) -> int:
             pipeline=_pipeline_arg(args),
             prefetch=args.prefetch,
             compile_cache=args.compile_cache,
+            fault_plan=args.fault_plan,
+            unit_deadline_seconds=args.unit_deadline,
+            max_retries=args.max_retries,
+            journal=not args.no_journal,
         )
         files = run_sweep(sweep)
         print(f"{len(files)} result artifacts in {sweep.output_dir}")
@@ -396,6 +439,11 @@ def _dispatch(args) -> int:
             strict_warnings=args.strict_warnings,
         )
 
+    if args.cmd == "chaos":
+        from dlbb_tpu.resilience.chaos import run_chaos
+
+        return run_chaos(plan=args.plan, output=args.output)
+
     if args.cmd == "e2e":
         try:
             from dlbb_tpu.bench.e2e import run_e2e_from_config
@@ -419,6 +467,10 @@ def _dispatch(args) -> int:
             args.config, zero1=args.zero1, zero_stage=args.zero_stage,
             output_dir=args.output, tp_overlap=args.tp_overlap,
         )
+        if result.get("preempted") and "step_time" not in result:
+            print(f"preempted at step {result['preempted_at_step']}; "
+                  "checkpoint saved — resume to continue")
+            return 0
         print(f"step mean {result['step_time']['mean'] * 1e3:.2f} ms")
         return 0
 

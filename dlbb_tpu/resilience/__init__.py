@@ -1,0 +1,57 @@
+"""Resilience subsystem: deterministic fault injection, crash-safe sweep
+journaling, artifact validation, graceful preemption, and the chaos gate.
+
+The hardened execution paths live in the layers they harden
+(``bench/runner`` retry/quarantine/watchdog, ``bench/schedule`` gate and
+compile deadlines, ``train/checkpoint`` integrity manifests); this
+package holds the shared machinery:
+
+- :mod:`~dlbb_tpu.resilience.inject` — seedable fault-injection registry
+  (``DLBB_FAULT_PLAN`` / ``--fault-plan``), zero instructions in timed
+  regions when inactive;
+- :mod:`~dlbb_tpu.resilience.journal` — append-only fsync'd
+  ``sweep_journal.jsonl``;
+- :mod:`~dlbb_tpu.resilience.validate` — artifact/timing validation
+  (what resume trusts);
+- :mod:`~dlbb_tpu.resilience.preempt` — SIGTERM → graceful-stop flag;
+- :mod:`~dlbb_tpu.resilience.errors` — failure taxonomy (transient vs
+  permanent, deadline, checkpoint corruption);
+- :mod:`~dlbb_tpu.resilience.chaos` — the ``cli chaos`` gate asserting
+  the invariants under every fault class (imported lazily: it pulls in
+  the whole bench stack).
+
+See ``docs/resilience.md`` for the contracts.
+"""
+
+from dlbb_tpu.resilience.errors import (
+    CheckpointCorruption,
+    CorruptStats,
+    DeadlineExceeded,
+    InjectedFault,
+    TornWrite,
+    TransientFault,
+    exception_chain,
+    is_transient,
+)
+from dlbb_tpu.resilience.journal import SweepJournal, read_journal
+from dlbb_tpu.resilience.preempt import PreemptionGuard
+from dlbb_tpu.resilience.validate import (
+    validate_result_json,
+    validate_timings,
+)
+
+__all__ = [
+    "CheckpointCorruption",
+    "CorruptStats",
+    "DeadlineExceeded",
+    "InjectedFault",
+    "PreemptionGuard",
+    "SweepJournal",
+    "TornWrite",
+    "TransientFault",
+    "exception_chain",
+    "is_transient",
+    "read_journal",
+    "validate_result_json",
+    "validate_timings",
+]

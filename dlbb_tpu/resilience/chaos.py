@@ -1,0 +1,338 @@
+"""Chaos gate: run a mini-sweep / mini-train under each fault class and
+assert the resilience invariants (``python -m dlbb_tpu.cli chaos``).
+
+Each class activates a deterministic fault plan
+(:mod:`dlbb_tpu.resilience.inject`), drives the real execution path (the
+PR-3 pipelined sweep engine on the simulated mesh; the orbax
+checkpointer), and asserts:
+
+- **no corrupt artifact survives** where resume or the stats pipeline
+  would trust it — every surviving result JSON passes
+  :func:`~dlbb_tpu.resilience.validate.validate_result_json`;
+- **transients recover**: retried configs complete with ``retries >= 1``
+  and finite stats;
+- **permanent faults fail closed**: the config lands in
+  ``sweep_manifest.json`` as quarantined with its exception chain, and
+  the journal records ``failed``;
+- **resume completes the grid exactly**: after a torn write, a SIGTERM,
+  or a SIGKILL mid-write, a ``--resume`` run produces the same artifact
+  set — same filenames, same schema keys, finite stats — as an
+  uninterrupted run of the same grid.
+
+The ``kill`` class SIGKILLs a real subprocess sweep (the
+``kill-mid-write`` site fires between the tmp write and ``os.replace``),
+because a same-process SIGKILL would take the gate down with it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+from dlbb_tpu.resilience.journal import read_journal
+from dlbb_tpu.resilience.validate import validate_result_json
+
+# Mini-grid shared by every class: 2 ops x 1 size x 4 ranks on the
+# simulated mesh — two configs, two work units, seconds per class.
+_MINI = dict(
+    implementation="chaos",
+    operations=("allreduce", "broadcast"),
+    data_sizes=(("1KB", 256),),
+    rank_counts=(4,),
+    dtype="float32",
+    warmup_iterations=1,
+    measurement_iterations=3,
+    compile_cache="off",
+    pipeline=True,
+)
+_GRID_FILES = sorted(
+    f"chaos_{op}_ranks4_1KB_fp32.json" for op in _MINI["operations"]
+)
+
+
+class ChaosFailure(AssertionError):
+    """An invariant did not hold under an injected fault."""
+
+
+def _sweep(out_dir: str, **kw):
+    from dlbb_tpu.bench import Sweep1D, run_sweep
+
+    cfg = dict(_MINI)
+    cfg.update(kw)
+    return run_sweep(Sweep1D(output_dir=out_dir, **cfg), verbose=False)
+
+
+def _manifest(out_dir: str) -> dict:
+    with open(Path(out_dir) / "sweep_manifest.json") as f:
+        return json.load(f)
+
+
+def _check(cond: bool, msg: str) -> None:
+    if not cond:
+        raise ChaosFailure(msg)
+
+
+def _assert_all_valid(paths) -> None:
+    for p in paths:
+        ok, why = validate_result_json(p)
+        _check(ok, f"corrupt artifact survived: {p} ({why})")
+
+
+def _assert_grid_equivalent(out_dir: str, reference_dir: str) -> None:
+    """Same artifact set as an uninterrupted run: same filenames, same
+    schema keys, finite stats (values differ — they are measurements)."""
+    got = sorted(p.name for p in Path(out_dir).glob("chaos_*.json"))
+    ref = sorted(p.name for p in Path(reference_dir).glob("chaos_*.json"))
+    _check(got == ref, f"artifact sets differ: {got} != {ref}")
+    for name in got:
+        a = json.loads((Path(out_dir) / name).read_text())
+        b = json.loads((Path(reference_dir) / name).read_text())
+        _check(sorted(a) == sorted(b),
+               f"{name}: schema keys differ after recovery")
+        ok, why = validate_result_json(Path(out_dir) / name)
+        _check(ok, f"{name}: invalid after recovery ({why})")
+
+
+# ---------------------------------------------------------------------------
+# fault classes
+# ---------------------------------------------------------------------------
+
+
+def _class_compile(work: Path, log: Callable[[str], None]) -> None:
+    out = str(work / "compile")
+    files = _sweep(out, fault_plan="compile-fail:@1", max_retries=0)
+    man = _manifest(out)
+    _check(man["configs"]["failed"] == 1,
+           f"compile failure not quarantined: {man['configs']}")
+    q = man["resilience"]["quarantined"]
+    _check(len(q) == 1 and "InjectedFault" in q[0]["error"]
+           and q[0]["traceback"],
+           "quarantine record lacks the exception chain")
+    _check(len(files) == len(_GRID_FILES) - 1,
+           "surviving configs did not all measure")
+    _assert_all_valid(files)
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "failed" for e in ev),
+           "journal has no failed record for the poisoned config")
+    log("compile-fail: quarantined with exception chain; grid drained")
+
+
+def _class_transient(work: Path, log: Callable[[str], None]) -> None:
+    out = str(work / "transient")
+    files = _sweep(out, fault_plan="exec-transient:1", max_retries=2)
+    _check(len(files) == len(_GRID_FILES),
+           "transient fault was not retried to completion")
+    _assert_all_valid(files)
+    retries = [json.loads(Path(p).read_text())["retries"] for p in files]
+    _check(sum(retries) == 1,
+           f"expected exactly one retried config, got retries={retries}")
+    _check(_manifest(out)["resilience"]["retries_total"] == 1,
+           "manifest retries_total wrong")
+    log("transient: retried with backoff, artifact flags retries=1")
+
+
+def _class_nan(work: Path, log: Callable[[str], None]) -> None:
+    out = str(work / "nan")
+    files = _sweep(out, fault_plan="stats-nan:1", max_retries=2)
+    _check(len(files) == len(_GRID_FILES),
+           "NaN-corrupted config did not re-measure")
+    _assert_all_valid(files)  # finite medians everywhere
+    retries = [json.loads(Path(p).read_text())["retries"] for p in files]
+    _check(sum(retries) >= 1, "NaN corruption was not detected pre-write")
+    log("stats-nan: corrupt stats never written; re-measured from scratch")
+
+
+def _class_torn(work: Path, log: Callable[[str], None]) -> None:
+    out = str(work / "torn")
+    _sweep(out, fault_plan="torn-write:@1", max_retries=0)
+    man = _manifest(out)
+    _check(man["configs"]["failed"] == 1, "torn write not failed closed")
+    torn = [p for p in Path(out).glob("chaos_*.json")
+            if not validate_result_json(p)[0]]
+    _check(len(torn) == 1, "expected exactly one torn artifact on disk")
+    # resume must re-validate, refuse the torn file, and re-measure it
+    files = _sweep(out, resume=True)
+    _check(len(files) == len(_GRID_FILES), "resume did not complete grid")
+    _assert_all_valid(files)
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "resume-invalid" for e in ev),
+           "journal has no resume-invalid record for the torn artifact")
+    log("torn-write: resume re-validated, re-measured; no corrupt artifact "
+        "trusted")
+
+
+def _class_hang(work: Path, log: Callable[[str], None]) -> None:
+    out = str(work / "hang")
+    t0 = time.perf_counter()
+    files = _sweep(out, fault_plan="exec-hang:@1,hang_seconds=30",
+                   unit_deadline_seconds=1.0, max_retries=0)
+    wall = time.perf_counter() - t0
+    man = _manifest(out)
+    _check(man["resilience"]["watchdog"]["abandoned_measurements"] == 1,
+           "watchdog did not abandon the hung measurement")
+    _check(man["configs"]["failed"] == 1, "hung unit not quarantined")
+    _check(len(files) == len(_GRID_FILES) - 1,
+           "pipeline did not drain past the hung unit")
+    _check(wall < 25.0,
+           f"sweep blocked behind the hang ({wall:.1f}s vs 30s sleep)")
+    _assert_all_valid(files)
+    log(f"exec-hang: abandoned at deadline, drained in {wall:.1f}s "
+        "(hang was 30s)")
+
+
+def _class_ckpt(work: Path, log: Callable[[str], None]) -> None:
+    import jax.numpy as jnp
+
+    from dlbb_tpu.resilience import inject
+    from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+    from dlbb_tpu.train.loop import TrainState
+
+    def state(step: int) -> TrainState:
+        return TrainState({"w": jnp.full((8, 8), float(step))},
+                          {"m": jnp.zeros((8,))},
+                          jnp.asarray(step, jnp.int32))
+
+    d = str(work / "ckpt")
+    with inject.plan_scope("ckpt-corrupt:@3"):
+        with Checkpointer(CheckpointConfig(d, max_to_keep=5)) as ckpt:
+            for s in (1, 2, 3):
+                _check(ckpt.maybe_save(state(s), force=True),
+                       f"save of step {s} failed")
+            ok, why = ckpt.verify_step(3)
+            _check(not ok, "corrupted step 3 passed verification")
+            _check(ckpt.latest_intact_step() == 2,
+                   "latest intact step should be 2")
+            restored = ckpt.restore_or(state(0))
+            _check(int(restored.step) == 2
+                   and float(restored.params["w"][0, 0]) == 2.0,
+                   "restore_or did not fall back to the intact step")
+    log(f"ckpt-corrupt: step 3 refused ({why.split('(')[0].strip()}); "
+        "fell back to intact step 2")
+
+
+def _class_preempt(work: Path, log: Callable[[str], None]) -> None:
+    out = str(work / "preempt")
+    clean = str(work / "preempt_reference")
+    _sweep(clean)
+    files = _sweep(out, fault_plan="preempt:@2")
+    man = _manifest(out)
+    _check(man["resilience"]["preempted"], "SIGTERM did not journal a stop")
+    _check(len(files) == 1, "preemption should stop before config 2")
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "preempted" for e in ev),
+           "journal has no preempted record")
+    files = _sweep(out, resume=True)
+    _check(len(files) == len(_GRID_FILES),
+           "resume after preemption did not complete the grid")
+    _assert_grid_equivalent(out, clean)
+    log("preempt: SIGTERM -> journaled stop; resume completed the grid "
+        "equivalently")
+
+
+def _class_kill(work: Path, log: Callable[[str], None]) -> None:
+    """SIGKILL mid-write (subprocess): the atomic writer must leave no
+    destination artifact; resume completes the grid equivalently."""
+    out = work / "kill"
+    clean = work / "kill_reference"
+    script = (
+        "from dlbb_tpu.utils.simulate import force_cpu_simulation\n"
+        "force_cpu_simulation(8)\n"
+        "from dlbb_tpu.bench import Sweep1D, run_sweep\n"
+        "import sys, json\n"
+        "cfg = json.loads(sys.argv[1])\n"
+        "run_sweep(Sweep1D(**cfg), verbose=False)\n"
+    )
+
+    def run_child(out_dir: str, **kw) -> int:
+        cfg = dict(_MINI)
+        cfg["output_dir"] = out_dir
+        cfg.update(kw)
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env.pop("DLBB_FAULT_PLAN", None)
+        proc = subprocess.run(
+            [sys.executable, "-c", script, json.dumps(cfg)],
+            env=env, capture_output=True, text=True, timeout=600,
+        )
+        if proc.returncode not in (0, -9):
+            raise ChaosFailure(
+                f"chaos child failed unexpectedly (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}"
+            )
+        return proc.returncode
+
+    rc = run_child(str(clean))
+    _check(rc == 0, "reference child sweep failed")
+    rc = run_child(str(out), fault_plan="kill-mid-write:@1")
+    _check(rc == -9, f"kill-mid-write child should die by SIGKILL, rc={rc}")
+    survivors = list(out.glob("chaos_*.json"))
+    _check(not survivors,
+           f"SIGKILL mid-write left destination artifacts: {survivors}")
+    # (uniquely-named *.tmp litter from the killed write is permitted —
+    # nothing ever trusts or collides with it)
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "started" for e in ev)
+           and not any(e["event"] == "completed" for e in ev),
+           "journal should show started-but-not-completed after SIGKILL")
+    rc = run_child(str(out), resume=True)
+    _check(rc == 0, "resume child sweep failed")
+    _assert_grid_equivalent(str(out), str(clean))
+    log("kill: SIGKILL mid-write left no trusted artifact; resume "
+        "re-measured to an equivalent grid")
+
+
+CHAOS_CLASSES: dict[str, Callable[[Path, Callable[[str], None]], None]] = {
+    "compile": _class_compile,
+    "transient": _class_transient,
+    "nan": _class_nan,
+    "torn": _class_torn,
+    "hang": _class_hang,
+    "ckpt": _class_ckpt,
+    "preempt": _class_preempt,
+    "kill": _class_kill,
+}
+
+
+def run_chaos(plan: str = "all", output: Optional[str] = None,
+              verbose: bool = True) -> int:
+    """Run the chaos gate; returns a process exit code (0 = every
+    invariant held)."""
+    import tempfile
+
+    def log(msg: str) -> None:
+        if verbose:
+            print(f"[chaos] {msg}")
+
+    names = list(CHAOS_CLASSES) if plan == "all" else [plan]
+    unknown = [n for n in names if n not in CHAOS_CLASSES]
+    if unknown:
+        print(f"[chaos] unknown class(es) {unknown}; "
+              f"known: {list(CHAOS_CLASSES)} + 'all'")
+        return 2
+    workroot = Path(output) if output else Path(tempfile.mkdtemp(
+        prefix="dlbb_chaos_"))
+    workroot.mkdir(parents=True, exist_ok=True)
+    failures = []
+    for name in names:
+        t0 = time.perf_counter()
+        try:
+            CHAOS_CLASSES[name](workroot, log)
+        except ChaosFailure as e:
+            failures.append((name, str(e)))
+            print(f"[chaos] FAIL {name}: {e}")
+        except Exception as e:  # noqa: BLE001 — gate must report, not die
+            failures.append((name, f"{type(e).__name__}: {e}"))
+            print(f"[chaos] ERROR {name}: {type(e).__name__}: {e}")
+        else:
+            log(f"{name} ok ({time.perf_counter() - t0:.1f}s)")
+    if failures:
+        print(f"[chaos] {len(failures)}/{len(names)} class(es) FAILED "
+              f"(workdir kept: {workroot})")
+        return 1
+    print(f"[chaos] all {len(names)} fault class(es) green "
+          f"(workdir: {workroot})")
+    return 0

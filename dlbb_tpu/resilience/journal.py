@@ -1,0 +1,152 @@
+"""Append-only crash-safe sweep journal (``sweep_journal.jsonl``).
+
+One JSON line per lifecycle event of each sweep config — ``planned``,
+``started``, ``completed``, ``failed``, ``resume-valid``,
+``resume-invalid``, ``skipped``, ``preempted`` — fsync'd per line, so a
+process killed at ANY instant leaves at most one torn trailing line
+(tolerated by :func:`read_journal`).  Together with atomic artifact
+writes (``utils/config.save_json``) this lets resume distinguish
+"completed" from "died mid-write": an artifact is trusted only if it
+exists, parses, and carries finite stats
+(``dlbb_tpu.resilience.validate``); the journal is the audit trail the
+chaos gate (and an operator) reads to see exactly what a crashed sweep
+did and what a resumed one re-ran.
+
+The journal is append-only across runs: a resumed sweep appends a new
+``sweep-start`` session marker and its own events after the crashed
+session's, preserving the full history of the grid.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Optional
+
+JOURNAL_NAME = "sweep_journal.jsonl"
+JOURNAL_SCHEMA = "dlbb_sweep_journal_v1"
+
+
+class SweepJournal:
+    """Append-only journal writer for one sweep session.
+
+    Every :meth:`event` is one line: ``json.dumps`` + newline, flushed and
+    fsync'd before returning — after a crash, every event the sweep
+    *reported* is durably on disk.  Events never raise into the sweep
+    (a full disk must not kill a measurement that already succeeded);
+    write failures flip :attr:`degraded` and are reported once.
+    """
+
+    def __init__(self, out_dir: "str | Path", meta: Optional[dict] = None,
+                 enabled: bool = True) -> None:
+        self.path = Path(out_dir) / JOURNAL_NAME
+        self.enabled = enabled
+        self.degraded = False
+        self._fh = None
+        if not enabled:
+            return
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        try:
+            # a crash mid-append leaves a torn tail WITHOUT a newline —
+            # terminate it first so this session's events stay
+            # line-delimited (the torn fragment stays visible to
+            # read_journal as exactly one unparseable line)
+            if self.path.exists():
+                with open(self.path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    if f.tell() > 0:
+                        f.seek(-1, os.SEEK_END)
+                        needs_newline = f.read(1) != b"\n"
+                    else:
+                        needs_newline = False
+            else:
+                needs_newline = False
+            self._fh = open(self.path, "a")
+            if needs_newline:
+                self._fh.write("\n")
+        except OSError:
+            self.degraded = True
+            self._fh = None
+            return
+        self.event("sweep-start",
+                   schema=JOURNAL_SCHEMA, pid=os.getpid(), **(meta or {}))
+
+    def event(self, event: str, config: Optional[str] = None,
+              **extra: Any) -> None:
+        if self._fh is None:
+            return
+        record = {"ts": time.time(), "event": event}
+        if config is not None:
+            record["config"] = config
+        record.update(extra)
+        try:
+            self._fh.write(json.dumps(record, default=str) + "\n")
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+        except (OSError, ValueError):
+            if not self.degraded:
+                self.degraded = True
+                print(f"[journal] WARNING: cannot append to {self.path}; "
+                      "journaling disabled for this session")
+            self.close()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+            self._fh = None
+
+    def __enter__(self) -> "SweepJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def read_journal(out_dir: "str | Path") -> tuple[list[dict], int]:
+    """Parse ``sweep_journal.jsonl`` under ``out_dir``.
+
+    Returns ``(events, torn_lines)`` — a line that does not parse (the
+    torn tail of a killed process) is counted, not fatal; a torn line
+    anywhere else is counted the same way (it can only mean a crashed
+    writer, and every parseable event remains trustworthy because each
+    was fsync'd before the next was attempted)."""
+    path = Path(out_dir) / JOURNAL_NAME
+    events: list[dict] = []
+    torn = 0
+    if not path.exists():
+        return events, torn
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                torn += 1
+                continue
+            if isinstance(rec, dict):
+                events.append(rec)
+            else:
+                torn += 1
+    return events, torn
+
+
+def completed_configs(events: list[dict]) -> set[str]:
+    """Config ids with a durable ``completed`` record."""
+    return {e["config"] for e in events
+            if e.get("event") == "completed" and "config" in e}
+
+
+def started_not_completed(events: list[dict]) -> set[str]:
+    """Config ids that started but never completed/failed — the set a
+    crash interrupted (resume must re-validate, never trust)."""
+    done = {e["config"] for e in events
+            if e.get("event") in ("completed", "failed") and "config" in e}
+    return {e["config"] for e in events
+            if e.get("event") == "started" and "config" in e} - done

@@ -1,0 +1,73 @@
+"""Artifact validation — what resume (and the chaos gate) trusts.
+
+Before this module, ``run_sweep``'s resume path trusted file EXISTENCE
+(``runner.py`` pre-PR5): a process killed mid-``json.dump`` of a
+non-atomic writer left a truncated result that resume skipped forever,
+leaking into the committed corpus.  Resume now trusts an artifact only if
+it passes :func:`validate_result_json` — parses, carries the result
+schema, and every timing sample is finite — and re-runs it (with a
+warning + journal record) otherwise.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+# The fields every sweep result JSON carries (reference-compatible schema,
+# ``bench/runner._run_one``) that downstream stats readers index on.
+REQUIRED_RESULT_FIELDS = (
+    "implementation",
+    "operation",
+    "num_ranks",
+    "num_elements",
+    "timings",
+)
+
+
+def validate_result_json(path: "str | Path") -> tuple[bool, str]:
+    """Is the artifact at ``path`` a complete, sane sweep result?
+
+    Returns ``(ok, reason)``; ``reason`` is ``"ok"`` or why the artifact
+    must not be trusted (truncated/torn JSON, missing schema fields,
+    empty or non-finite timings)."""
+    path = Path(path)
+    if not path.exists():
+        return False, "missing"
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as e:
+        return False, f"unparseable ({type(e).__name__}: {e})"
+    if not isinstance(data, dict):
+        return False, "not a JSON object"
+    missing = [k for k in REQUIRED_RESULT_FIELDS if k not in data]
+    if missing:
+        return False, f"missing fields {missing}"
+    try:
+        arr = np.asarray(data["timings"], dtype=np.float64)
+    except (TypeError, ValueError) as e:
+        return False, f"non-numeric timings ({e})"
+    if arr.size == 0:
+        return False, "empty timings"
+    if not np.isfinite(arr).all():
+        bad = int(np.size(arr) - np.isfinite(arr).sum())
+        return False, f"non-finite timings ({bad}/{arr.size} samples)"
+    if not np.isfinite(np.median(arr)):
+        return False, "non-finite median"
+    return True, "ok"
+
+
+def validate_timings(timings) -> tuple[bool, str]:
+    """Pre-write check on a just-measured timing matrix (the writer-side
+    twin of :func:`validate_result_json`): a NaN/Inf sample — injected or
+    real — must never reach an artifact."""
+    arr = np.asarray(timings, dtype=np.float64)
+    if arr.size == 0:
+        return False, "empty timings"
+    if not np.isfinite(arr).all():
+        bad = int(np.size(arr) - np.isfinite(arr).sum())
+        return False, f"non-finite timings ({bad}/{arr.size} samples)"
+    return True, "ok"

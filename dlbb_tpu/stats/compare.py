@@ -353,13 +353,16 @@ def _md_table(rows: list[dict], columns: list[str]) -> list[str]:
 
 def _write_csv(rows: list[dict], columns: list[str], path: Path) -> None:
     import csv
+    import io
 
-    path.parent.mkdir(parents=True, exist_ok=True)
-    with open(path, "w", newline="") as f:
-        w = csv.DictWriter(f, fieldnames=columns)
-        w.writeheader()
-        for r in rows:
-            w.writerow({k: r.get(k) for k in columns})
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    buf = io.StringIO()
+    w = csv.DictWriter(buf, fieldnames=columns)
+    w.writeheader()
+    for r in rows:
+        w.writerow({k: r.get(k) for k in columns})
+    atomic_write_text(buf.getvalue(), path, newline="")
 
 
 def _distinct_configs(rows: list[dict]) -> int:
@@ -485,9 +488,10 @@ def write_comparison(
                                "median_speedup"])
     md.append("")
 
+    from dlbb_tpu.utils.config import atomic_write_text
+
     out_dir.mkdir(parents=True, exist_ok=True)
     (out_dir / "COMPARISON.md").write_text("\n".join(md))
-    (out_dir / "comparison_summary.json").write_text(
-        json.dumps(summary, indent=2) + "\n"
-    )
+    atomic_write_text(json.dumps(summary, indent=2) + "\n",
+                      out_dir / "comparison_summary.json")
     return summary

@@ -31,11 +31,14 @@ Differences (documented, not silent):
 from __future__ import annotations
 
 import csv
+import io
 import json
 from pathlib import Path
 from typing import Any, Optional
 
 import numpy as np
+
+from dlbb_tpu.utils.config import atomic_write_text
 
 _DTYPE_BYTES = {
     "bfloat16": 2,
@@ -206,23 +209,25 @@ def process_1d_results(
                 print(f"  ERROR processing {json_file.name}: {e}")
             continue
         out = output_dir / (json_file.stem + "_stats.json")
-        with open(out, "w") as f:
-            json.dump(result, f, indent=2)
+        # atomic (tmp + fsync + os.replace): a killed stats pass must not
+        # leave a torn *_stats.json that the next report run would parse
+        atomic_write_text(json.dumps(result, indent=2), out)
         results.append(result)
 
     if results:
-        with open(output_dir / csv_name, "w", newline="") as f:
-            writer = csv.DictWriter(f, fieldnames=CSV_COLUMNS)
-            writer.writeheader()
-            for r in results:
-                writer.writerow(
-                    {
-                        k: v
-                        for k, v in r.items()
-                        if k not in ("per_rank_means_us",
-                                     "percentile_caveat", "backend")
-                    }
-                )
+        buf = io.StringIO()
+        writer = csv.DictWriter(buf, fieldnames=CSV_COLUMNS)
+        writer.writeheader()
+        for r in results:
+            writer.writerow(
+                {
+                    k: v
+                    for k, v in r.items()
+                    if k not in ("per_rank_means_us",
+                                 "percentile_caveat", "backend")
+                }
+            )
+        atomic_write_text(buf.getvalue(), output_dir / csv_name, newline="")
         if verbose:
             print(f"Consolidated CSV saved: {output_dir / csv_name}")
     return results

@@ -17,10 +17,20 @@ needs train-state checkpointing, so this subsystem goes beyond parity:
   never saves).
 - Multi-host safe: orbax coordinates the write across processes; under a
   single-process simulated mesh it degrades to a plain local save.
+- **Integrity contract** (docs/resilience.md): every save writes a
+  checksum manifest (sha256 + size per file, atomic) under
+  ``<dir>/.integrity/<step>.json``; :meth:`Checkpointer.restore` verifies
+  before restoring and refuses a corrupt step
+  (:class:`~dlbb_tpu.resilience.errors.CheckpointCorruption`);
+  :meth:`Checkpointer.restore_or` instead falls back to the newest
+  *intact* step, logging which step was rejected and why — a torn or
+  bit-rotted checkpoint can roll training back, never crash it or
+  silently feed it garbage.
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 from pathlib import Path
 from typing import Any, Optional
@@ -28,7 +38,10 @@ from typing import Any, Optional
 import jax
 import orbax.checkpoint as ocp
 
+from dlbb_tpu.resilience import inject
+from dlbb_tpu.resilience.errors import CheckpointCorruption
 from dlbb_tpu.train.loop import TrainState
+from dlbb_tpu.utils.config import save_json
 
 __all__ = [
     "CheckpointConfig",
@@ -37,6 +50,20 @@ __all__ = [
     "restore_checkpoint",
     "latest_step",
 ]
+
+INTEGRITY_DIRNAME = ".integrity"
+INTEGRITY_SCHEMA = "dlbb_ckpt_integrity_v1"
+
+
+def _file_digest(path: Path, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
 
 
 class CheckpointConfig:
@@ -48,11 +75,19 @@ class CheckpointConfig:
         save_interval_steps: int = 1,
         max_to_keep: int = 3,
         enabled: bool = True,
+        integrity: bool = True,
     ) -> None:
         self.directory = str(Path(directory).absolute())
         self.save_interval_steps = int(save_interval_steps)
         self.max_to_keep = int(max_to_keep)
         self.enabled = bool(enabled)
+        # per-save checksum manifests (docs/resilience.md).  Each save
+        # re-reads and sha256s the whole step tree — O(checkpoint bytes)
+        # added to every interval save; at multi-GB state scale set
+        # ``integrity: false`` to trade corruption detection for save
+        # throughput (steps then restore as "unverified", like legacy
+        # checkpoints)
+        self.integrity = bool(integrity)
 
     @classmethod
     def from_dict(cls, d: dict[str, Any]) -> "CheckpointConfig":
@@ -61,6 +96,7 @@ class CheckpointConfig:
             save_interval_steps=d.get("save_interval_steps", 1),
             max_to_keep=d.get("max_to_keep", 3),
             enabled=d.get("enabled", True),
+            integrity=d.get("integrity", True),
         )
 
 
@@ -92,26 +128,152 @@ class Checkpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    # ---- integrity manifest (docs/resilience.md) -----------------------
+
+    def _integrity_dir(self) -> Path:
+        return Path(self.config.directory) / INTEGRITY_DIRNAME
+
+    def _manifest_path(self, step: int) -> Path:
+        return self._integrity_dir() / f"{int(step)}.json"
+
+    def _step_dir(self, step: int) -> Optional[Path]:
+        """The on-disk directory of ``step`` (orbax's default layout is
+        ``<dir>/<step>``; fall back to a scan so a customised
+        ``step_name_format`` still verifies)."""
+        base = Path(self.config.directory)
+        cand = base / str(int(step))
+        if cand.is_dir():
+            return cand
+        for p in sorted(base.iterdir()):
+            if p.is_dir() and p.name != INTEGRITY_DIRNAME \
+                    and p.name.lstrip("0") in (str(int(step)), "") \
+                    and p.name.strip("0") != "":
+                return p
+            if p.is_dir() and p.name.endswith(f"_{int(step)}"):
+                return p
+        return None
+
+    def _write_integrity(self, step: int) -> None:
+        """Checksum every file of the just-saved step (sha256 + size),
+        atomically; prune manifests of steps retention already deleted."""
+        step_dir = self._step_dir(step)
+        if step_dir is None:
+            return
+        files = {}
+        for p in sorted(step_dir.rglob("*")):
+            if p.is_file():
+                files[str(p.relative_to(step_dir))] = {
+                    "sha256": _file_digest(p),
+                    "bytes": p.stat().st_size,
+                }
+        save_json(
+            {"schema": INTEGRITY_SCHEMA, "step": int(step), "files": files},
+            self._manifest_path(step),
+        )
+        live = {int(s) for s in self._mgr.all_steps()}
+        for m in self._integrity_dir().glob("*.json"):
+            try:
+                if int(m.stem) not in live:
+                    m.unlink()
+            except ValueError:
+                continue
+
+    def verify_step(self, step: int) -> tuple[bool, str]:
+        """Does ``step`` on disk match its integrity manifest?
+
+        Returns ``(ok, reason)``.  A step saved before this subsystem
+        existed has no manifest: accepted (``"unverified"``) so legacy
+        checkpoints keep restoring, but every new save is covered."""
+        import json
+
+        step_dir = self._step_dir(step)
+        if step_dir is None:
+            return False, "step directory missing"
+        mpath = self._manifest_path(step)
+        if not mpath.exists():
+            return True, "unverified (no integrity manifest; pre-PR5 save)"
+        try:
+            manifest = json.loads(mpath.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            return False, f"integrity manifest unreadable ({e})"
+        for rel, meta in manifest.get("files", {}).items():
+            p = step_dir / rel
+            if not p.is_file():
+                return False, f"missing file {rel}"
+            if p.stat().st_size != meta["bytes"]:
+                return False, (f"size mismatch on {rel} "
+                               f"({p.stat().st_size} != {meta['bytes']})")
+            if _file_digest(p) != meta["sha256"]:
+                return False, f"checksum mismatch on {rel}"
+        return True, "ok"
+
+    def latest_intact_step(self) -> Optional[int]:
+        """Newest step that passes :meth:`verify_step` (None if none)."""
+        for step in sorted(self._mgr.all_steps(), reverse=True):
+            if self.verify_step(int(step))[0]:
+                return int(step)
+        return None
+
+    # ---- save / restore ------------------------------------------------
+
     def maybe_save(self, state: TrainState, force: bool = False) -> bool:
-        """Save if the manager's interval policy says so. Returns True if saved."""
+        """Save if the manager's interval policy says so. Returns True if
+        saved.  Every save is followed by its integrity manifest."""
         if not self.config.enabled:
             return False
         step = int(jax.device_get(state.step))
         if step in self._mgr.all_steps():
             return False  # already on disk (e.g. final force after interval save)
-        return bool(
+        saved = bool(
             self._mgr.save(
                 step, args=ocp.args.StandardSave(_as_pytree(state)), force=force
             )
         )
+        if saved and self.config.integrity:
+            # async checkpointing is disabled in __init__, so the wait is
+            # a no-op today; it stays for correctness if that ever flips
+            # (the manifest must hash the COMPLETED write)
+            self._mgr.wait_until_finished()
+            self._write_integrity(step)
+            if inject.fire("ckpt-corrupt"):
+                # chaos harness: bit-rot the payload AFTER its manifest —
+                # verification must reject this step and restore_or must
+                # fall back to the newest intact one
+                self._corrupt_step(step)
+        return saved
+
+    def _corrupt_step(self, step: int) -> None:
+        step_dir = self._step_dir(step)
+        if step_dir is None:
+            return
+        victims = [p for p in sorted(step_dir.rglob("*"))
+                   if p.is_file() and p.stat().st_size > 0]
+        if not victims:
+            return
+        victim = max(victims, key=lambda p: p.stat().st_size)
+        blob = bytearray(victim.read_bytes())
+        mid = len(blob) // 2
+        blob[mid] = blob[mid] ^ 0xFF
+        victim.write_bytes(bytes(blob[: max(1, mid)]))  # flip + truncate
 
     def restore(self, like: TrainState, step: Optional[int] = None) -> TrainState:
-        """Restore at ``step`` (default: latest) with ``like``'s shardings."""
+        """Restore at ``step`` (default: latest) with ``like``'s shardings.
+
+        Verifies integrity first and raises
+        :class:`~dlbb_tpu.resilience.errors.CheckpointCorruption` on a
+        corrupt step — an explicit restore must fail closed, not feed the
+        trainer a torn state (``restore_or`` is the falling-back path)."""
         if step is None:
             step = self.latest_step()
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint under {self.config.directory}"
+            )
+        ok, why = self.verify_step(int(step))
+        if not ok:
+            raise CheckpointCorruption(
+                f"checkpoint step {step} under {self.config.directory} "
+                f"failed integrity verification: {why}"
             )
         abstract = jax.tree.map(_abstractify, _as_pytree(like))
         restored = self._mgr.restore(
@@ -120,10 +282,34 @@ class Checkpointer:
         return _from_pytree(restored)
 
     def restore_or(self, state: TrainState) -> TrainState:
-        """Resume from the latest checkpoint if one exists, else pass through."""
-        if self.latest_step() is None:
-            return state
-        return self.restore(state)
+        """Resume from the newest INTACT checkpoint; pass through when none.
+
+        Every candidate step is verified (and its restore attempted)
+        newest-first; a corrupt or unrestorable step is logged — which
+        step, and why — and the next older one is tried, so a torn final
+        save after a crash rolls training back one interval instead of
+        wedging the resume."""
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        for step in steps:
+            ok, why = self.verify_step(int(step))
+            if not ok:
+                print(f"[checkpoint] step {step}: integrity FAILED ({why})"
+                      " — falling back to the previous step")
+                continue
+            try:
+                return self.restore(state, step=int(step))
+            except CheckpointCorruption:
+                raise  # verify_step already passed; a raise here is a bug
+            except Exception as e:  # noqa: BLE001 — fall back, loudly
+                print(f"[checkpoint] step {step}: restore failed "
+                      f"({type(e).__name__}: {e}) — falling back to the "
+                      "previous step")
+                continue
+        if steps:
+            print(f"[checkpoint] no intact checkpoint among steps "
+                  f"{steps} under {self.config.directory}; starting from "
+                  "the initial state")
+        return state
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

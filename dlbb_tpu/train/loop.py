@@ -30,6 +30,8 @@ hand-written collective schedule:
 
 from __future__ import annotations
 
+import os
+import signal
 import time
 import warnings
 from pathlib import Path
@@ -475,49 +477,102 @@ def run_train(
             state, loss = jit_step(state, batch, tgt)
             float(loss)  # forces completion on any backend
 
+    # Graceful preemption (docs/resilience.md): SIGTERM between steps
+    # breaks the loop and falls through to the forced final checkpoint
+    # save below — the TPU-fleet preemption notice becomes a clean
+    # resume point instead of a mid-step kill.  The `preempt` fault site
+    # (dlbb_tpu.resilience.inject) drives the same path in the chaos gate.
+    from dlbb_tpu.resilience import PreemptionGuard, inject
+
     losses = []
-    if mode == "per_iter":
-        step_times = []
-        for i in range(iters):
-            with step_annotation("train_step", i):
-                with Timer() as t:
-                    state, loss = jit_step(state, batch, tgt)
-                    jax.block_until_ready(loss)
-                step_times.append(t.elapsed)
-            losses.append(float(loss))
-            if ckpt is not None:
-                ckpt.maybe_save(state)
-        timing_meta = {
-            "timing_mode": "per_iter",
-            "timing_method": "time.perf_counter() + jax.block_until_ready()",
-        }
-    else:
-        # optimisation trajectory first (each float(loss) forces completion,
-        # so losses are real), then honest chained step timing
-        for _ in range(iters):
-            state, loss = jit_step(state, batch, tgt)
-            losses.append(float(loss))
-            if ckpt is not None:
-                ckpt.maybe_save(state)
+    preempted_at: Optional[int] = None
+    with PreemptionGuard() as guard:
+        if mode == "per_iter":
+            step_times = []
+            for i in range(iters):
+                if inject.fire("preempt"):
+                    os.kill(os.getpid(), signal.SIGTERM)
+                if guard.requested:
+                    preempted_at = int(jax.device_get(state.step))
+                    break
+                with step_annotation("train_step", i):
+                    with Timer() as t:
+                        state, loss = jit_step(state, batch, tgt)
+                        jax.block_until_ready(loss)
+                    step_times.append(t.elapsed)
+                losses.append(float(loss))
+                if ckpt is not None:
+                    ckpt.maybe_save(state)
+            timing_meta = {
+                "timing_mode": "per_iter",
+                "timing_method":
+                    "time.perf_counter() + jax.block_until_ready()",
+            }
+        else:
+            # optimisation trajectory first (each float(loss) forces
+            # completion, so losses are real), then honest chained step
+            # timing
+            for _ in range(iters):
+                if inject.fire("preempt"):
+                    os.kill(os.getpid(), signal.SIGTERM)
+                if guard.requested:
+                    preempted_at = int(jax.device_get(state.step))
+                    break
+                state, loss = jit_step(state, batch, tgt)
+                losses.append(float(loss))
+                if ckpt is not None:
+                    ckpt.maybe_save(state)
 
-        def timed_step(b, t, st):
-            new_state, _ = jit_step(st, b, t)
-            return new_state
+            if preempted_at is None:
+                def timed_step(b, t, st):
+                    new_state, _ = jit_step(st, b, t)
+                    return new_state
 
-        with annotate("measure"):
-            # state is donated to the timing loop (halves resident
-            # TrainState HBM — decisive for Adam at 1B on the 16 GiB
-            # chip); the returned carry IS the post-timing state and
-            # everything below (final ckpt save, final_step) uses it
-            step_times, timing_meta, state = time_fn_chained(
-                timed_step, state, warmup=1, iterations=iters,
-                chunk_size=min(5, iters), op_args=(batch, tgt),
-                compiler_options=comp_opts or None,
-            )
+                with annotate("measure"):
+                    # state is donated to the timing loop (halves resident
+                    # TrainState HBM — decisive for Adam at 1B on the
+                    # 16 GiB chip); the returned carry IS the post-timing
+                    # state and everything below (final ckpt save,
+                    # final_step) uses it
+                    step_times, timing_meta, state = time_fn_chained(
+                        timed_step, state, warmup=1, iterations=iters,
+                        chunk_size=min(5, iters), op_args=(batch, tgt),
+                        compiler_options=comp_opts or None,
+                    )
+            else:
+                step_times, timing_meta = [], {
+                    "timing_mode": "chained",
+                    "timing_method": "preempted before measurement",
+                }
 
     if ckpt is not None:
+        # forced final save — ON the preemption path this is the "final
+        # save + flush" the SIGTERM contract promises (the restore after
+        # preemption starts from the last finished step)
         ckpt.maybe_save(state, force=True)
         ckpt.close()
+
+    if preempted_at is not None and not step_times:
+        # preempted before any timed sample: there is nothing honest to
+        # publish — save happened above; report the resume point instead
+        # of a fabricated benchmark artifact
+        result = {
+            "preempted": True,
+            "preempted_at_step": preempted_at,
+            "mode": MODE_NAMES[stage],
+            "zero_stage": stage,
+            "resumed_from_step": resumed_from,
+            "final_step": int(jax.device_get(state.step)),
+            "checkpoint_saved": ckpt is not None,
+            "losses": losses,
+            "timestamp": time.time(),
+        }
+        if verbose:
+            print(f"[train/{result['mode']}] preempted at step "
+                  f"{preempted_at}; checkpoint "
+                  f"{'saved' if ckpt is not None else 'DISABLED'} — "
+                  "no benchmark artifact written")
+        return result
 
     # Utilisation accounting (the train-side analogue of the E2E harness's
     # achieved-TFLOP/s; parity depth with reference ``run_mpi.py:217-225``):
@@ -552,6 +607,10 @@ def run_train(
         "mode": MODE_NAMES[stage],
         "zero_stage": stage,
         "resumed_from_step": resumed_from,
+        # graceful-preemption marker: True when SIGTERM cut the loop short
+        # after >=1 timed sample (stats below cover the completed steps)
+        "preempted": preempted_at is not None,
+        "preempted_at_step": preempted_at,
         "mesh": plan.mesh_dict(),
         "learning_rate": lr,
         "optimizer": opt_name,

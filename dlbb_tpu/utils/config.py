@@ -1,5 +1,15 @@
 """Config / JSON IO (reference ``utils.py:90-102`` load_config,
-``utils.py:268-279`` save_results)."""
+``utils.py:268-279`` save_results) + the repo's one atomic-write helper.
+
+Every artifact writer in the repo goes through :func:`atomic_write_text`
+(directly or via :func:`save_json`): tmp file in the destination
+directory, ``flush`` + ``fsync``, then ``os.replace`` — so a process
+killed at any instant leaves either the complete old artifact or the
+complete new one, never a truncated JSON/CSV that a resume-mode sweep or
+the stats pipeline would trust.  The ``non-atomic-artifact-write``
+comm-lint rule (``dlbb_tpu/analysis/source_lint.py``) keeps new writers
+from bypassing it.
+"""
 
 from __future__ import annotations
 
@@ -25,25 +35,60 @@ def load_config(path: str | Path) -> dict[str, Any]:
     return cfg
 
 
-def save_json(data: dict[str, Any], path: str | Path) -> Path:
-    """Write a result dict as pretty JSON, creating parent dirs.
+def atomic_write_text(text: str, path: str | Path, newline: str = "") -> Path:
+    """Durably replace ``path`` with ``text``: tmp + fsync + ``os.replace``.
 
-    Write-to-tmp + ``os.replace`` so a killed run (time-budgeted publisher
-    sweeps) can never leave a truncated artifact behind — resume-mode sweeps
-    trust file existence, so a partial JSON would be skipped forever and
-    leak into the committed corpus."""
+    The tmp file lives next to the destination (``os.replace`` must not
+    cross filesystems) with a unique name — concurrent writers (multi-host
+    sweeps on a shared filesystem) must not truncate each other's
+    in-flight tmp file.  ``newline`` passes through to ``open`` for CSV
+    writers (``newline=""`` is also the plain-text default: content is
+    written byte-for-byte, no translation).
+    """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    # unique tmp name: concurrent writers (multi-host sweeps on a shared
-    # filesystem) must not truncate each other's in-flight tmp file
     tmp = path.with_name(f"{path.name}.{os.getpid()}.{uuid4().hex[:8]}.tmp")
     try:
-        with open(tmp, "w") as f:
-            json.dump(data, f, indent=2, default=_jsonify)
+        with open(tmp, "w", newline=newline) as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     finally:
         tmp.unlink(missing_ok=True)
     return path
+
+
+def save_json(data: dict[str, Any], path: str | Path) -> Path:
+    """Write a result dict as pretty JSON via :func:`atomic_write_text`,
+    creating parent dirs — a killed run can never leave a truncated
+    artifact behind (resume validates content, but a torn file would
+    still cost a warning + re-measure; see
+    ``dlbb_tpu/resilience/validate.py``)."""
+    from dlbb_tpu.resilience import inject
+
+    path = Path(path)
+    text = json.dumps(data, indent=2, default=_jsonify)
+    if inject.fire("torn-write"):
+        # chaos harness: model the LEGACY non-atomic writer dying
+        # mid-dump — a truncated JSON lands at the FINAL path and the
+        # "process" crashes (TornWrite) before completing the config
+        path.parent.mkdir(parents=True, exist_ok=True)
+        frac = inject.param("torn_fraction")
+        with open(path, "w") as f:
+            f.write(text[: max(1, int(len(text) * frac))])
+        raise inject.TornWrite(str(path))
+    if inject.fire("kill-mid-write"):
+        # chaos harness: SIGKILL between the tmp write and os.replace —
+        # with the atomic writer the destination never appears; resume
+        # re-runs the config (tmp litter is harmless and uniquely named)
+        tmp = path.with_name(f"{path.name}.{os.getpid()}.killed.tmp")
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp.write_text(text)
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+    return atomic_write_text(text, path)
 
 
 def _jsonify(obj: Any):

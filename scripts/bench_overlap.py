@@ -38,8 +38,11 @@ import tempfile
 import time
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 CHIP = "--chip" in sys.argv[1:]
 if not CHIP:
@@ -206,7 +209,8 @@ def main() -> int:
             }
         ),
     }
-    Path(args.output).write_text(json.dumps(payload, indent=1) + "\n")
+    atomic_write_text(json.dumps(payload, indent=1) + "\n",
+                      Path(args.output))
     for s in SCHEDULES:
         print(f"[{s:5s}] e2e fwd median {e2e_out[s]['median_s']*1e3:8.2f} ms"
               f" | ag_matmul {micro_out[s]['ag_matmul']['median_s']*1e3:7.3f}"

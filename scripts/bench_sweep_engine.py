@@ -22,8 +22,11 @@ import tempfile
 import time
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
 
@@ -199,7 +202,8 @@ def main() -> int:
         "runs": runs,
         "summary": summary,
     }
-    Path(args.output).write_text(json.dumps(record, indent=2) + "\n")
+    atomic_write_text(json.dumps(record, indent=2) + "\n",
+                      Path(args.output))
     print(json.dumps(summary, indent=2))
     print(f"written to {args.output}")
     return 0

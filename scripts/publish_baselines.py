@@ -40,8 +40,11 @@ import sys
 import time
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
 
@@ -899,7 +902,7 @@ def stage_multichip() -> None:
     out["host"] = "cpu-simulated 8-device mesh (host-RAM bandwidth, not ICI)"
     dest = RESULTS / "multichip" / "bench_allreduce_multichip_8ranks.json"
     dest.parent.mkdir(parents=True, exist_ok=True)
-    dest.write_text(json.dumps(out, indent=2) + "\n")
+    atomic_write_text(json.dumps(out, indent=2) + "\n", dest)
     log(f"  {out['value']} {out['unit']} "
         f"(vs oneCCL baseline x{out['vs_baseline']})")
 
@@ -1113,7 +1116,7 @@ def stage_baseline() -> None:
             ladder[name] = entry
         published["train_zero_ladder"] = ladder
     data["published"] = published
-    baseline_path.write_text(json.dumps(data, indent=2) + "\n")
+    atomic_write_text(json.dumps(data, indent=2) + "\n", baseline_path)
     log("BASELINE.json published section updated")
 
 

@@ -30,8 +30,11 @@ import sys
 import time
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 E2E_FWD = {
     (8, 512): "results/e2e/xla_tpu_1b_full_s512_world1.json",
@@ -126,7 +129,7 @@ def run_traced(batch: int, seq: int, steps: int, output: str) -> Path:
     out = Path(output)
     out.mkdir(parents=True, exist_ok=True)
     path = out / f"train_attrib_trace_b{batch}_s{seq}.json"
-    path.write_text(json.dumps(summary, indent=2) + "\n")
+    atomic_write_text(json.dumps(summary, indent=2) + "\n", path)
     print(f"trace summary -> {path}", flush=True)
     return path
 
@@ -198,12 +201,12 @@ def decompose(output: str) -> Path:
     out = Path(output)
     out.mkdir(parents=True, exist_ok=True)
     path = out / "train_attrib_decomposition.json"
-    path.write_text(json.dumps(
+    atomic_write_text(json.dumps(
         {"rows": rows,
          "method": "backward_s = sgd_dots step - e2e forward; "
                    "optimizer_delta_s = adam_bf16m_dots step - sgd_dots "
                    "step; all chip-measured chained timings",
-         "timestamp": time.time()}, indent=2) + "\n")
+         "timestamp": time.time()}, indent=2) + "\n", path)
     print(f"decomposition ({len(rows)} rows) -> {path}", flush=True)
     return path
 

@@ -27,9 +27,12 @@ import json
 import sys
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(Path(__file__).resolve().parent))  # _publish_common
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 CONFIGS = (
     ("1B", "simplified", 512),
@@ -111,7 +114,7 @@ def write_boundary_artifact(size: str, attention: str, seq: int,
     out = Path(output)
     out.mkdir(parents=True, exist_ok=True)
     path = out / f"{_artifact_name(size, attention, seq)}_infeasible.json"
-    path.write_text(json.dumps(boundary, indent=2) + "\n")
+    atomic_write_text(json.dumps(boundary, indent=2) + "\n", path)
     return path
 
 

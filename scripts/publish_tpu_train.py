@@ -31,9 +31,12 @@ import json
 import sys
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
 sys.path.insert(0, str(Path(__file__).resolve().parent))  # _publish_common
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 # (name_suffix, training overrides, model overrides, input overrides)
 # input overrides {} = the canonical BATCH_SIZE/SEQ_LEN shape.
@@ -190,7 +193,7 @@ def write_boundary_artifact(suffix: str, output: str, exit_code: int,
     out = Path(output)
     out.mkdir(parents=True, exist_ok=True)
     path = out / f"{_artifact_name(suffix)}_infeasible.json"
-    path.write_text(json.dumps(boundary, indent=2) + "\n")
+    atomic_write_text(json.dumps(boundary, indent=2) + "\n", path)
     return path
 
 

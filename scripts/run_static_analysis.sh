@@ -31,4 +31,14 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_bench.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_collective_matmul.py -q \
     -m overlap_smoke -p no:cacheprovider
 
+# chaos smoke (docs/resilience.md): the fault matrix through the real
+# sweep engine — transient retry, NaN-stat refusal, torn-write resume
+# re-validation, hung-unit watchdog quarantine, SIGTERM journaled stop,
+# corrupted-checkpoint fallback — each injection deterministic, each
+# invariant asserted (no corrupt artifact survives; resume completes the
+# grid).  The subprocess SIGKILL class runs in the slow tier
+# (tests/test_resilience.py::test_chaos_gate_kill_class).
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -m chaos_smoke -p no:cacheprovider
+
 echo "comm-lint: clean (report: $REPORT)"

@@ -22,8 +22,11 @@ import sys
 import time
 from pathlib import Path
 
+
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+from dlbb_tpu.utils.config import atomic_write_text  # noqa: E402
 
 
 def main() -> int:
@@ -80,7 +83,7 @@ def main() -> int:
     }
     path = REPO / "results" / "timing_crosscheck.json"
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_text(json.dumps(out, indent=2) + "\n")
+    atomic_write_text(json.dumps(out, indent=2) + "\n", path)
     print(f"wrote {path}")
     return 0
 

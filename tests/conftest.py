@@ -46,6 +46,16 @@ def pytest_configure(config):
         "overlap_smoke: ring-decomposed collective-matmul smoke (tier-1; "
         "also invoked standalone by scripts/run_static_analysis.sh)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos_smoke: resilience fault-matrix smoke (tier-1; also invoked "
+        "standalone by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
+        "chaos classes, multi-minute sweeps)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

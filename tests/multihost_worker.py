@@ -9,10 +9,10 @@ devices per process -> one global 4-device mesh) and drives a tiny real
 
 - ``_gather_timings``: process_count == 2 -> the host-side allgather
   branch; the written artifact must carry one timing row per host.
-- ``_resume_exists``: the collective resume decision; exercised with the
-  hosts *disagreeing* (each passes a different path, only process 0's
-  exists) -> must return False on BOTH hosts, and with both agreeing ->
-  must return True on both.
+- ``_resume_ok``: the collective resume decision (existence + artifact
+  validation); exercised with the hosts *disagreeing* (only process 0
+  holds a valid artifact at the probe path) -> must return False on BOTH
+  hosts, and with both agreeing -> must return True on both.
 
 NOT imported by pytest collection (no ``test_`` prefix in module-level
 names); runs standalone only.
@@ -47,7 +47,7 @@ def main(process_id: int, port: int, out_dir: str) -> None:
 
     from dlbb_tpu.bench.runner import (
         Sweep1D,
-        _resume_exists,
+        _resume_ok,
         run_sweep,
     )
 
@@ -74,21 +74,30 @@ def main(process_id: int, port: int, out_dir: str) -> None:
     )
     assert resumed == written, (resumed, written)
 
-    # disagreeing hosts: only process 0's probe path exists -> the
-    # collective decision must be False on BOTH (a per-host decision here
-    # is exactly the pod-hang bug the docstring warns about)
-    mine = Path(out_dir) / f"probe_proc{process_id}.marker"
+    # disagreeing hosts: only process 0 holds a VALID artifact at the
+    # probe path (a copy of the real one, so its local check passes) ->
+    # the collective decision must be False on BOTH (a per-host decision
+    # here is exactly the pod-hang bug the docstring warns about)
+    mine = Path(out_dir) / f"probe_proc{process_id}.json"
     if process_id == 0:
-        mine.write_text("present")
+        mine.write_text(Path(written[0]).read_text())
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices("probe_written")
-    disagree = _resume_exists(mine)
+    disagree, _ = _resume_ok(mine)
     assert disagree is False, disagree
 
-    # agreeing hosts: the shared artifact exists everywhere -> True
-    agree = _resume_exists(Path(written[0]))
+    # agreeing hosts: the shared VALID artifact exists everywhere -> True
+    agree, _ = _resume_ok(Path(written[0]))
     assert agree is True, agree
+
+    # a torn artifact (truncated JSON) must not be trusted even though it
+    # EXISTS on both hosts — the validation half of the collective check
+    torn = Path(out_dir) / f"torn_shared_proc{process_id}.json"
+    torn.write_text(Path(written[0]).read_text()[:40])
+    multihost_utils.sync_global_devices("torn_written")
+    trusted, why = _resume_ok(torn)
+    assert trusted is False, (trusted, why)
 
     # e2e cross-host CV branch (bench/e2e.py): a tiny forward benchmark
     # over the global 4-device dp mesh.  The fixed-seed data layer is

@@ -380,6 +380,48 @@ def test_lint_unsorted_set_iteration():
     assert lint_source(fixed, "fixture.py")[0] == []
 
 
+ATOMIC_WRITE_FIXTURE = textwrap.dedent("""
+    import json
+
+    def publish(result, path):
+        with open(path, "w") as f:
+            json.dump(result, f, indent=2)
+        path.with_suffix(".summary.json").write_text(
+            json.dumps(result) + "\\n"
+        )
+""")
+
+
+def test_lint_non_atomic_artifact_write():
+    """Both torn-write shapes are flagged (in-place json.dump and
+    truncate-then-write write_text); the save_json migration and the
+    suppression comment both silence it; the helper file itself is
+    exempt (its json.dump-to-tmp IS the atomic mechanism)."""
+    findings, _ = lint_source(ATOMIC_WRITE_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["non-atomic-artifact-write"] * 2
+    fixed = textwrap.dedent("""
+        from dlbb_tpu.utils.config import save_json
+
+        def publish(result, path):
+            save_json(result, path)
+    """)
+    assert lint_source(fixed, "fixture.py")[0] == []
+    suppressed = ATOMIC_WRITE_FIXTURE.replace(
+        "json.dump(result, f, indent=2)",
+        "json.dump(result, f, indent=2)"
+        "  # comm-lint: disable=non-atomic-artifact-write",
+    ).replace(
+        "path.with_suffix(\".summary.json\").write_text(",
+        "# comm-lint: disable=non-atomic-artifact-write\n"
+        "        path.with_suffix(\".summary.json\").write_text(",
+    )
+    findings, hits = lint_source(suppressed, "fixture.py")
+    assert findings == [] and hits == 2
+    # the atomic helper's own tmp-file json.dump is sanctioned
+    assert lint_source(ATOMIC_WRITE_FIXTURE,
+                       "dlbb_tpu/utils/config.py")[0] == []
+
+
 # ---------------------------------------------------------------------------
 # standing guarantees + report plumbing
 # ---------------------------------------------------------------------------

@@ -1,0 +1,616 @@
+"""Resilience subsystem tests (``dlbb_tpu/resilience/``, PR 5).
+
+The fault matrix: every injection site fires deterministically under a
+seeded plan and an inactive plan is a provable no-op; the hardened sweep
+driver retries transients (recomputing from scratch), quarantines
+permanent failures with their exception chain, abandons hung units at
+the watchdog deadline while the pipeline drains, survives torn writes
+(resume re-validates instead of trusting existence), and turns SIGTERM
+into a journaled stop a ``--resume`` run completes exactly; checkpoint
+integrity refuses corrupt steps and falls back to the newest intact one.
+"""
+
+import ast
+import json
+import os
+import signal
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dlbb_tpu.bench import Sweep1D, run_sweep
+from dlbb_tpu.resilience import inject
+from dlbb_tpu.resilience.errors import (
+    CorruptStats,
+    DeadlineExceeded,
+    TransientFault,
+    exception_chain,
+    is_transient,
+)
+from dlbb_tpu.resilience.journal import (
+    SweepJournal,
+    read_journal,
+    started_not_completed,
+)
+from dlbb_tpu.resilience.preempt import PreemptionGuard
+from dlbb_tpu.resilience.validate import (
+    validate_result_json,
+    validate_timings,
+)
+from dlbb_tpu.utils.config import atomic_write_text, save_json
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _tiny(tmp_path, out="results", **kw):
+    defaults = dict(
+        implementation="rt",
+        operations=("allreduce", "broadcast"),
+        data_sizes=(("1KB", 256),),
+        rank_counts=(4,),
+        dtype="float32",
+        warmup_iterations=1,
+        measurement_iterations=3,
+        output_dir=str(tmp_path / out),
+        compile_cache="off",
+        pipeline=True,
+    )
+    defaults.update(kw)
+    return Sweep1D(**defaults)
+
+
+def _manifest(tmp_path, out="results"):
+    return json.loads(
+        (tmp_path / out / "sweep_manifest.json").read_text()
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault plan parsing / determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_plan_triggers_deterministic():
+    plan = inject.FaultPlan.parse("exec-transient:2,stats-nan:@3")
+    fires = [plan.fire("exec-transient") for _ in range(4)]
+    assert fires == [True, True, False, False]
+    fires = [plan.fire("stats-nan") for _ in range(4)]
+    assert fires == [False, False, True, False]
+    assert plan.fired == [("exec-transient", 1), ("exec-transient", 2),
+                          ("stats-nan", 3)]
+    # an unlisted site never fires and burns no bookkeeping
+    assert plan.fire("torn-write") is False
+    assert "torn-write" not in plan.hits
+
+
+def test_fault_plan_probabilistic_seeded():
+    """The p-trigger is a seeded coin: two identically-seeded plans agree
+    hit for hit (crc32-based site seed, stable across processes)."""
+    a = inject.FaultPlan.parse("exec-transient:p0.5,seed=7")
+    b = inject.FaultPlan.parse("exec-transient:p0.5,seed=7")
+    seq_a = [a.fire("exec-transient") for _ in range(32)]
+    seq_b = [b.fire("exec-transient") for _ in range(32)]
+    assert seq_a == seq_b
+    assert True in seq_a and False in seq_a  # a real coin, not a constant
+    c = inject.FaultPlan.parse("exec-transient:p0.5,seed=8")
+    assert [c.fire("exec-transient") for _ in range(32)] != seq_a
+
+
+def test_fault_plan_rejects_unknown():
+    with pytest.raises(ValueError, match="unknown fault site"):
+        inject.FaultPlan.parse("no-such-site:1")
+    with pytest.raises(ValueError, match="unknown fault-plan parameter"):
+        inject.FaultPlan.parse("nope=3")
+
+
+def test_inactive_plan_is_noop():
+    assert inject.active() is None
+    assert inject.fire("exec-transient") is False
+    with inject.plan_scope("exec-transient:1") as plan:
+        assert inject.fire("exec-transient") is True
+        assert plan.fired == [("exec-transient", 1)]
+    assert inject.active() is None and inject.fire("exec-transient") is False
+
+
+def test_timed_regions_carry_zero_injection_instructions():
+    """The zero-overhead contract, statically: ``utils/timing.py`` — the
+    only module that brackets device work with clocks — must never
+    reference the resilience package, so an inactive (or even active)
+    plan adds zero instructions to any timed region."""
+    src = (REPO / "dlbb_tpu" / "utils" / "timing.py").read_text()
+    assert "resilience" not in src and "inject" not in src
+    # and the runner's injection sites live outside time_collective: the
+    # only statements between the gate acquisition and the measurement
+    # call are the try that wraps it
+    tree = ast.parse((REPO / "dlbb_tpu" / "bench" / "runner.py").read_text())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.With):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    name = getattr(sub.func, "attr", "")
+                    assert name != "fire", (
+                        "inject.fire inside a with-block of runner.py — "
+                        "possible timed-region injection"
+                    )
+
+
+def test_is_transient_taxonomy():
+    assert is_transient(TransientFault("x"))
+    assert is_transient(CorruptStats("x"))
+    assert not is_transient(RuntimeError("x"))
+    assert not is_transient(DeadlineExceeded("u", 1.0))
+    chain = exception_chain(ValueError("inner"))
+    assert chain["chain"][0]["type"] == "ValueError" and chain["traceback"]
+
+
+# ---------------------------------------------------------------------------
+# atomic writes + validation
+# ---------------------------------------------------------------------------
+
+
+def test_atomic_write_text_replaces_and_cleans_tmp(tmp_path):
+    p = tmp_path / "a" / "x.json"
+    atomic_write_text("one", p)
+    assert p.read_text() == "one"
+    atomic_write_text("two", p)
+    assert p.read_text() == "two"
+    assert list(p.parent.glob("*.tmp")) == []
+
+
+def test_save_json_torn_write_injection(tmp_path):
+    """The torn-write site models the legacy non-atomic writer: a
+    truncated JSON lands at the FINAL path and the writer 'crashes' —
+    exactly what resume re-validation must refuse."""
+    p = tmp_path / "r.json"
+    with inject.plan_scope("torn-write:@1"):
+        with pytest.raises(inject.TornWrite):
+            save_json({"operation": "x", "timings": [[1.0]]}, p)
+    assert p.exists()
+    ok, why = validate_result_json(p)
+    assert not ok and "unparseable" in why
+    # the next save (site exhausted) repairs it atomically
+    with inject.plan_scope("torn-write:@1") as plan:
+        plan.fire("torn-write")  # burn the single trigger
+        save_json({"implementation": "i", "operation": "x", "num_ranks": 2,
+                   "num_elements": 4, "timings": [[1.0, 2.0]]}, p)
+    assert validate_result_json(p)[0]
+
+
+def test_validate_result_json_rejects_corruption(tmp_path):
+    good = {"implementation": "i", "operation": "allreduce", "num_ranks": 2,
+            "num_elements": 4, "timings": [[1e-3, 2e-3]]}
+    p = tmp_path / "g.json"
+    save_json(good, p)
+    assert validate_result_json(p) == (True, "ok")
+    assert validate_result_json(tmp_path / "missing.json")[1] == "missing"
+    (tmp_path / "torn.json").write_text(json.dumps(good)[:25])
+    assert "unparseable" in validate_result_json(tmp_path / "torn.json")[1]
+    bad = dict(good, timings=[[1e-3, float("nan")]])
+    (tmp_path / "nan.json").write_text(
+        json.dumps(bad).replace("NaN", "NaN"))
+    assert "non-finite" in validate_result_json(tmp_path / "nan.json")[1]
+    missing = {k: v for k, v in good.items() if k != "timings"}
+    save_json(missing, tmp_path / "m.json")
+    assert "missing fields" in validate_result_json(tmp_path / "m.json")[1]
+    save_json(dict(good, timings=[]), tmp_path / "e.json")
+    assert "empty" in validate_result_json(tmp_path / "e.json")[1]
+    assert not validate_timings([[1.0, float("inf")]])[0]
+    assert validate_timings([[1.0, 2.0]])[0]
+
+
+def test_journal_appends_and_tolerates_torn_tail(tmp_path):
+    with SweepJournal(tmp_path, meta={"kind": "1d"}) as j:
+        j.event("planned", config="a.json")
+        j.event("started", config="a.json")
+        j.event("completed", config="a.json")
+        j.event("started", config="b.json")
+    # simulate a crash mid-append: torn trailing line
+    with open(tmp_path / "sweep_journal.jsonl", "a") as f:
+        f.write('{"ts": 1, "event": "comp')
+    events, torn = read_journal(tmp_path)
+    assert torn == 1
+    assert [e["event"] for e in events] == [
+        "sweep-start", "planned", "started", "completed", "started"]
+    assert started_not_completed(events) == {"b.json"}
+    # append-only across sessions: a resumed run adds its own marker
+    with SweepJournal(tmp_path, meta={"resume": True}) as j:
+        j.event("resume-valid", config="a.json")
+    events, _ = read_journal(tmp_path)
+    assert [e["event"] for e in events].count("sweep-start") == 2
+
+
+# ---------------------------------------------------------------------------
+# hardened sweep driver (the fault matrix through the real engine)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos_smoke
+def test_sweep_transient_retried_and_flagged(tmp_path, devices):
+    files = run_sweep(_tiny(tmp_path, fault_plan="exec-transient:1",
+                            max_retries=2), verbose=False)
+    assert len(files) == 2
+    retries = sorted(json.loads(f.read_text())["retries"] for f in files)
+    assert retries == [0, 1]
+    man = _manifest(tmp_path)
+    assert man["resilience"]["retries_total"] == 1
+    assert man["configs"]["failed"] == 0
+    for f in files:
+        assert validate_result_json(f)[0]
+    events, _ = read_journal(tmp_path / "results")
+    assert any(e["event"] == "retry" for e in events)
+
+
+@pytest.mark.chaos_smoke
+def test_sweep_nan_stats_never_written(tmp_path, devices):
+    """Injected NaN/Inf in the timing vector is caught BEFORE the write
+    and the config re-measures from scratch — no corrupt artifact ever
+    exists on disk, even transiently under the atomic writer."""
+    files = run_sweep(_tiny(tmp_path, fault_plan="stats-nan:1",
+                            max_retries=2), verbose=False)
+    assert len(files) == 2
+    for f in files:
+        ok, why = validate_result_json(f)
+        assert ok, why
+    assert sum(json.loads(f.read_text())["retries"] for f in files) == 1
+
+
+def test_sweep_transient_exhausted_is_quarantined(tmp_path, devices):
+    """A transient that keeps firing past max_retries fails CLOSED: the
+    config lands in the manifest with its exception chain and the journal
+    records failed — never a silent skip."""
+    files = run_sweep(_tiny(tmp_path, fault_plan="exec-transient:*",
+                            max_retries=1), verbose=False)
+    assert files == []
+    man = _manifest(tmp_path)
+    assert man["configs"]["failed"] == 2
+    q = man["resilience"]["quarantined"]
+    assert len(q) == 2
+    for rec in q:
+        assert rec["retries"] == 1
+        assert "TransientFault" in rec["error"]
+        assert rec["traceback"]
+    events, _ = read_journal(tmp_path / "results")
+    assert sum(1 for e in events if e["event"] == "failed") == 2
+
+
+@pytest.mark.chaos_smoke
+def test_sweep_torn_write_resume_revalidates(tmp_path, devices):
+    run_sweep(_tiny(tmp_path, fault_plan="torn-write:@1", max_retries=0),
+              verbose=False)
+    out = tmp_path / "results"
+    torn = [p for p in out.glob("rt_*.json")
+            if not validate_result_json(p)[0]]
+    assert len(torn) == 1
+    files = run_sweep(_tiny(tmp_path, resume=True), verbose=False)
+    assert len(files) == 2
+    for f in files:
+        assert validate_result_json(f)[0]
+    events, _ = read_journal(out)
+    invalid = [e for e in events if e["event"] == "resume-invalid"]
+    assert len(invalid) == 1 and invalid[0]["config"] == torn[0].name
+    man = _manifest(tmp_path)
+    assert man["configs"]["resume_invalid"] == 1
+    assert man["configs"]["resumed"] == 1
+
+
+def test_sweep_resume_trusts_only_valid_artifacts(tmp_path, devices):
+    """The PR-5 headline fix: resume no longer trusts existence.  A valid
+    artifact is skipped untouched; a truncated one re-measures."""
+    first = run_sweep(_tiny(tmp_path), verbose=False)
+    assert len(first) == 2
+    victim, kept = sorted(first)
+    victim.write_text(victim.read_text()[:30])  # torn
+    kept_mtime = kept.stat().st_mtime_ns
+    resumed = run_sweep(_tiny(tmp_path, resume=True), verbose=False)
+    assert sorted(resumed) == sorted(first)
+    assert kept.stat().st_mtime_ns == kept_mtime, "valid artifact re-ran"
+    assert validate_result_json(victim)[0], "torn artifact not re-measured"
+
+
+def test_sweep_compile_failure_quarantined_with_chain(tmp_path, devices):
+    files = run_sweep(_tiny(tmp_path, fault_plan="compile-fail:@1",
+                            max_retries=0), verbose=False)
+    assert len(files) == 1
+    man = _manifest(tmp_path)
+    assert man["configs"]["failed"] == 1
+    [q] = man["resilience"]["quarantined"]
+    assert q["phase"] == "compile" and "InjectedFault" in q["error"]
+
+
+@pytest.mark.chaos_smoke
+def test_sweep_hung_unit_watchdog_quarantine_and_drain(tmp_path, devices):
+    """A hung measurement is abandoned at the deadline and quarantined;
+    the rest of the grid still measures and the sweep returns long before
+    the hang would — the pipeline drain is never blocked."""
+    t0 = time.perf_counter()
+    files = run_sweep(
+        _tiny(tmp_path, fault_plan="exec-hang:@1,hang_seconds=30",
+              unit_deadline_seconds=0.75, max_retries=0),
+        verbose=False,
+    )
+    wall = time.perf_counter() - t0
+    assert len(files) == 1
+    assert wall < 25.0, f"sweep blocked behind the hang ({wall:.1f}s)"
+    man = _manifest(tmp_path)
+    assert man["resilience"]["watchdog"]["abandoned_measurements"] == 1
+    assert man["resilience"]["watchdog"]["gate_degraded"] is True
+    [q] = man["resilience"]["quarantined"]
+    assert "DeadlineExceeded" in q["error"]
+    assert validate_result_json(files[0])[0]
+
+
+def test_scheduler_abandoned_unit_never_recompiled_inline():
+    """A build that already blew its compile deadline must not be re-run
+    inline for a config that shares the unit — a deterministically
+    hanging build would hang the consumer thread, where no watchdog
+    applies.  Every later consumer quarantines fast instead."""
+    import threading
+
+    from dlbb_tpu.bench.schedule import CompileAheadScheduler, WorkUnit
+
+    release = threading.Event()
+
+    def hang_build():
+        release.wait(20)
+        return (lambda x: x), (lambda x: x)
+
+    unit = WorkUnit(key=("hang",), build=hang_build, label="hang")
+    sched = CompileAheadScheduler([unit], pipeline=True)
+    sched.start()
+    try:
+        with pytest.raises(DeadlineExceeded):
+            sched.get(unit, deadline=0.3)
+        assert sched.wedged
+        t0 = time.perf_counter()
+        with pytest.raises(DeadlineExceeded, match="previously abandoned"):
+            sched.get(unit, deadline=0.3)
+        # the second consumer did NOT sit in the hanging build inline
+        assert time.perf_counter() - t0 < 5.0
+    finally:
+        release.set()
+        sched.close()
+
+
+def test_watchdog_zombie_write_suppressed(tmp_path, devices):
+    """An abandoned measurement thread that wakes up AFTER its config was
+    quarantined must not write its artifact — resume and the stats
+    pipeline would trust a file the manifest says failed."""
+    run_sweep(
+        _tiny(tmp_path, fault_plan="exec-hang:@1,hang_seconds=2",
+              unit_deadline_seconds=0.5, max_retries=0),
+        verbose=False,
+    )
+    man = _manifest(tmp_path)
+    [q] = man["resilience"]["quarantined"]
+    quarantined_file = tmp_path / "results" / q["config"]
+    # wait past the zombie's wake-up + measurement; its write must have
+    # been suppressed by the cancellation token
+    time.sleep(3.5)
+    assert not quarantined_file.exists(), (
+        "zombie thread resurrected a quarantined config on disk"
+    )
+
+
+def test_sweep_hung_compile_wedge_inline_fallback(tmp_path, devices):
+    """A wedged background compile is abandoned at the deadline; later
+    units compile inline on the consumer thread (the worker is stuck) so
+    the rest of the grid still measures."""
+    files = run_sweep(
+        _tiny(tmp_path, fault_plan="compile-hang:@1,hang_seconds=6",
+              unit_deadline_seconds=0.75, max_retries=0),
+        verbose=False,
+    )
+    assert len(files) == 1
+    man = _manifest(tmp_path)
+    wd = man["resilience"]["watchdog"]
+    assert wd["abandoned_compiles"] == 1 and wd["scheduler_wedged"]
+    assert validate_result_json(files[0])[0]
+
+
+@pytest.mark.chaos_smoke
+def test_sweep_preemption_journaled_resume_equivalent(tmp_path, devices):
+    """SIGTERM between configs -> graceful journaled stop; a --resume run
+    completes the grid with the same artifact set (names, schema keys,
+    finite stats) as an uninterrupted run."""
+    ref = run_sweep(_tiny(tmp_path, out="ref"), verbose=False)
+    files = run_sweep(_tiny(tmp_path, fault_plan="preempt:@2"),
+                      verbose=False)
+    assert len(files) == 1
+    # the handler was restored: SIGTERM disposition is back to default
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler, signal.SIG_IGN,
+    ) or callable(signal.getsignal(signal.SIGTERM))
+    man = _manifest(tmp_path)
+    assert man["resilience"]["preempted"] is True
+    events, _ = read_journal(tmp_path / "results")
+    assert any(e["event"] == "preempted" for e in events)
+    resumed = run_sweep(_tiny(tmp_path, resume=True), verbose=False)
+    assert sorted(p.name for p in resumed) == sorted(p.name for p in ref)
+    for got in resumed:
+        want = json.loads((tmp_path / "ref" / got.name).read_text())
+        have = json.loads(got.read_text())
+        assert sorted(have) == sorted(want), got.name
+        assert validate_result_json(got)[0]
+
+
+def test_sweep_without_plan_has_no_resilience_cost(tmp_path, devices):
+    """No active plan: artifacts carry retries=0, the manifest's
+    resilience block shows a clean run, and no injection bookkeeping
+    exists (fire() was a pure no-op throughout)."""
+    assert inject.active() is None
+    files = run_sweep(_tiny(tmp_path), verbose=False)
+    assert all(json.loads(f.read_text())["retries"] == 0 for f in files)
+    man = _manifest(tmp_path)
+    r = man["resilience"]
+    assert r["fault_plan"] is None
+    assert r["retries_total"] == 0 and r["quarantined"] == []
+    assert r["watchdog"]["abandoned_measurements"] == 0
+    assert r["preempted"] is False
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity
+# ---------------------------------------------------------------------------
+
+
+def _state(step):
+    import jax.numpy as jnp
+
+    from dlbb_tpu.train.loop import TrainState
+
+    return TrainState({"w": jnp.full((8, 8), float(step))},
+                      {"m": jnp.zeros((8,))},
+                      jnp.asarray(step, jnp.int32))
+
+
+@pytest.mark.chaos_smoke
+def test_checkpoint_corruption_falls_back_to_intact_step(tmp_path, devices):
+    from dlbb_tpu.resilience.errors import CheckpointCorruption
+    from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+    with Checkpointer(CheckpointConfig(str(tmp_path / "ck"),
+                                       max_to_keep=5)) as ckpt:
+        for s in (1, 2, 3):
+            assert ckpt.maybe_save(_state(s), force=True)
+        assert ckpt.verify_step(3) == (True, "ok")
+        ckpt._corrupt_step(3)
+        ok, why = ckpt.verify_step(3)
+        assert not ok and ("mismatch" in why or "missing" in why)
+        assert ckpt.latest_intact_step() == 2
+        restored = ckpt.restore_or(_state(0))
+        assert int(restored.step) == 2
+        assert float(restored.params["w"][0, 0]) == 2.0
+        with pytest.raises(CheckpointCorruption):
+            ckpt.restore(_state(0), step=3)
+
+
+def test_checkpoint_corrupt_injection_site(tmp_path, devices):
+    from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+    with inject.plan_scope("ckpt-corrupt:@2"):
+        with Checkpointer(CheckpointConfig(str(tmp_path / "ck"),
+                                           max_to_keep=5)) as ckpt:
+            ckpt.maybe_save(_state(1), force=True)
+            ckpt.maybe_save(_state(2), force=True)  # fires -> corrupts
+            restored = ckpt.restore_or(_state(0))
+            assert int(restored.step) == 1
+
+
+def test_checkpoint_all_corrupt_returns_initial(tmp_path, devices, capsys):
+    from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+    with Checkpointer(CheckpointConfig(str(tmp_path / "ck"),
+                                       max_to_keep=5)) as ckpt:
+        ckpt.maybe_save(_state(1), force=True)
+        ckpt._corrupt_step(1)
+        initial = _state(0)
+        restored = ckpt.restore_or(initial)
+        assert int(restored.step) == 0
+    out = capsys.readouterr().out
+    assert "integrity FAILED" in out and "no intact checkpoint" in out
+
+
+def test_checkpoint_legacy_without_manifest_still_restores(tmp_path,
+                                                           devices):
+    """A checkpoint saved before the integrity subsystem (no manifest)
+    keeps restoring — accepted as 'unverified', not rejected."""
+    from dlbb_tpu.train.checkpoint import (
+        INTEGRITY_DIRNAME,
+        CheckpointConfig,
+        Checkpointer,
+    )
+
+    d = tmp_path / "ck"
+    with Checkpointer(CheckpointConfig(str(d), max_to_keep=5)) as ckpt:
+        ckpt.maybe_save(_state(1), force=True)
+        m = d / INTEGRITY_DIRNAME / "1.json"
+        assert m.exists()
+        m.unlink()  # pre-PR5 checkpoint: no manifest
+        ok, why = ckpt.verify_step(1)
+        assert ok and "unverified" in why
+        assert int(ckpt.restore_or(_state(0)).step) == 1
+
+
+# ---------------------------------------------------------------------------
+# preemption guard + train loop
+# ---------------------------------------------------------------------------
+
+
+def test_preemption_guard_flag_and_restore():
+    prev = signal.getsignal(signal.SIGTERM)
+    with PreemptionGuard() as guard:
+        assert guard.installed
+        assert not guard.requested
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 5.0
+        while not guard.requested and time.time() < deadline:
+            time.sleep(0.01)
+        assert guard.requested
+        assert guard.signal_received == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_train_preemption_forces_final_save(tmp_path, devices):
+    """SIGTERM mid-train breaks the loop and forces the final checkpoint
+    save — the restore after preemption starts from the last finished
+    step (the Varuna/CheckFreq graceful-preemption contract)."""
+    from dlbb_tpu.train.checkpoint import latest_step
+    from dlbb_tpu.train.loop import run_train
+
+    config = {
+        "experiment": {"name": "preempt_train"},
+        "model": {"hidden_size": 32, "num_layers": 2, "num_heads": 4,
+                  "ffn_intermediate": 64, "attention": "full",
+                  "dtype": "float32"},
+        "parallelism": {"world_size": 2, "data_parallel": 4},
+        "input": {"batch_size": 8, "sequence_length": 16, "seed": 42},
+        "execution": {"warmup_iterations": 1, "benchmark_iterations": 6},
+        "training": {"learning_rate": 1e-2,
+                     "checkpoint": {"directory": str(tmp_path / "ck")}},
+    }
+    with inject.plan_scope("preempt:@3"):
+        result = run_train(config, verbose=False)
+    assert result["preempted_at_step"] is not None
+    saved = latest_step(str(tmp_path / "ck"))
+    assert saved is not None
+    assert saved == result["final_step"]
+    # and the saved step passes integrity
+    from dlbb_tpu.train.checkpoint import CheckpointConfig, Checkpointer
+
+    with Checkpointer(CheckpointConfig(str(tmp_path / "ck"))) as ckpt:
+        ok, why = ckpt.verify_step(saved)
+        assert ok, why
+
+
+# ---------------------------------------------------------------------------
+# chaos gate (subprocess class is slow -> tier-1 skips it, CI smoke runs
+# the in-process classes through the same entry point as the CLI)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos_smoke
+def test_chaos_gate_fast_classes(tmp_path, devices):
+    from dlbb_tpu.resilience.chaos import run_chaos
+
+    for name in ("transient", "torn"):
+        assert run_chaos(plan=name, output=str(tmp_path / name),
+                         verbose=False) == 0
+
+
+def test_chaos_gate_rejects_unknown_class(tmp_path):
+    from dlbb_tpu.resilience.chaos import run_chaos
+
+    assert run_chaos(plan="nope", output=str(tmp_path)) == 2
+
+
+@pytest.mark.slow
+def test_chaos_gate_kill_class(tmp_path, devices):
+    """The SIGKILL-mid-write class (real subprocesses): atomic writes
+    leave no destination artifact, and resume re-measures to a grid
+    equivalent to an uninterrupted run — the acceptance invariant."""
+    from dlbb_tpu.resilience.chaos import run_chaos
+
+    assert run_chaos(plan="kill", output=str(tmp_path / "kill"),
+                     verbose=False) == 0
