@@ -268,7 +268,11 @@ def main() -> int:
         log("falling back to the CPU-simulated 8-rank mesh")
         from dlbb_tpu.utils.simulate import force_cpu_simulation
 
-        force_cpu_simulation(8)
+        # the reason makes the fallback a first-class degraded topology:
+        # any sweep this process runs journals it and stamps it into
+        # sweep_manifest.json (utils/simulate.topology_record)
+        force_cpu_simulation(8, degraded_reason=(
+            f"accelerator backend unreachable ({fail_reason})"))
         out = bench_allreduce_multichip(8)
         out["degraded"] = (
             f"accelerator backend unreachable ({fail_reason}); "

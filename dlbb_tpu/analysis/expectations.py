@@ -25,6 +25,35 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+# --- compressed-collective wire model (docs/compression.md) ---------------
+#
+# These constants are the single source of truth for the quantised wire
+# format: dlbb_tpu/comm/compression.py imports them (this module must stay
+# importable WITHOUT jax — the source lint runs backend-free — so the
+# dependency points this way, not comm -> analysis -> comm).
+COMPRESSIONS = ("int8", "fp8")
+# payload bytes per element on the wire (int8 and fp8 e4m3 are both 1 B)
+COMPRESSED_WIRE_ITEM_BYTES = {"int8": 1, "fp8": 1}
+# one fp32 scale per chunk of this many elements — the scale-tensor side
+# channel, charged to every byte ceiling below
+SCALE_CHUNK_ELEMS = 256
+SCALE_ITEM_BYTES = 4
+
+
+def scale_bytes(num_elements: int) -> int:
+    """Bytes of the fp32 scale side channel for a quantised payload of
+    ``num_elements`` (one scale per SCALE_CHUNK_ELEMS-element chunk)."""
+    return -(-num_elements // SCALE_CHUNK_ELEMS) * SCALE_ITEM_BYTES
+
+
+def padded_elems(num_elements: int) -> int:
+    """Elements actually on the wire for a quantised payload of
+    ``num_elements``: quantize_chunked zero-pads each payload to a
+    SCALE_CHUNK_ELEMS multiple, and the padding travels — an analytic
+    model that ignored it would undercount small/misaligned payloads
+    and reject correct implementations against their own ceiling."""
+    return -(-num_elements // SCALE_CHUNK_ELEMS) * SCALE_CHUNK_ELEMS
+
 # Registry op -> allowed HLO collective kinds, and the kind that MUST
 # appear at least once (the op's defining primitive).
 #
@@ -99,22 +128,36 @@ AXIS_EXPECTED_KINDS: dict[str, set[str]] = {
     "sp_ulysses": {"all-to-all"},                           # Ulysses resharding
     "pp": {"collective-permute", "all-reduce"},             # hops + masked psum
     "ep": {"all-reduce"},                                   # expert combine psum
+    # dp with quantised gradient reduction (training.grad_compression):
+    # ppermute ring + wire-dtype all-gather; all-reduce only for the
+    # scalar loss mean (byte-bounded by the total-wire ceiling)
+    "dp_compressed": {"collective-permute", "all-gather", "all-reduce"},
 }
 
 
 def plan_expected_kinds(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
                         ep: int = 1, attention: str = "full",
                         zero_stage: int = 0,
-                        tp_overlap: str = "off") -> set[str]:
+                        tp_overlap: str = "off",
+                        compression: str = "none") -> set[str]:
     """The union of collective kinds a (plan, attention, ZeRO stage,
-    tp_overlap schedule) combination is allowed to lower to.  Anything
-    else in the compiled module — most importantly an all-gather in a
-    plain TP forward, or a surviving all-reduce in an overlapped one — is
-    a sharding mismatch."""
+    tp_overlap schedule, grad-compression mode) combination is allowed to
+    lower to.  Anything else in the compiled module — most importantly an
+    all-gather in a plain TP forward, or a surviving all-reduce in an
+    overlapped one — is a sharding mismatch."""
     kinds: set[str] = set()
     if dp > 1:
-        kinds |= ({"all-reduce"} if zero_stage == 0
-                  else AXIS_EXPECTED_KINDS["dp"])
+        if compression not in (None, "none"):
+            # quantised gradient reduction (docs/compression.md): the dp
+            # reduction is a collective-permute ring + a wire-dtype
+            # all-gather.  all-reduce stays allowed for the scalar loss
+            # mean ONLY — a gradient-sized all-reduce surviving here blows
+            # the total-wire ceiling (max_total_wire_bytes), which is the
+            # gate proving XLA did not dequantise before the collective.
+            kinds |= AXIS_EXPECTED_KINDS["dp_compressed"]
+        else:
+            kinds |= ({"all-reduce"} if zero_stage == 0
+                      else AXIS_EXPECTED_KINDS["dp"])
     if tp > 1:
         kinds |= AXIS_EXPECTED_KINDS[
             "tp_overlap" if tp_overlap != "off" else "tp"
@@ -170,6 +213,13 @@ class TargetExpectation:
                         (None = unchecked); catches "oversized" collectives
                         such as a full-parameter all-gather where only an
                         activation-sized transfer is planned.
+    max_total_wire_bytes: ceiling on the SUM of analytic per-device wire
+                        bytes (``wire_bytes``) over every collective in the
+                        module (None = unchecked).  The compressed-
+                        collective gate: a quantised reduction that XLA
+                        secretly dequantised back to bf16 moves ~2x the
+                        wire and blows this ceiling even when every
+                        individual instruction looks plausible.
     expect_donation:    the computation must donate at least one input
                         buffer (train-step convention — without it XLA
                         keeps input and output state resident).
@@ -179,6 +229,7 @@ class TargetExpectation:
     required_any: Optional[set[str]] = None
     min_required: int = 1
     max_bytes_per_instr: Optional[int] = None
+    max_total_wire_bytes: Optional[int] = None
     expect_donation: bool = False
 
 
@@ -200,6 +251,114 @@ def op_expectation(op_name: str, payload_bytes_per_rank: int,
         required_any=set(required_any),
         min_required=spec.get("min_required", 1),
         max_bytes_per_instr=int(payload_bytes_per_rank * slack),
+    )
+
+
+# Analytic per-device wire bytes of each registry op's IMPLEMENTATION
+# (comm/ops.py SPMD encodings — e.g. broadcast is a psum of a masked
+# contribution, so its wire is an all-reduce's, not a tree broadcast's).
+# ``n`` is the op's per-rank element count (the [P, n] row / the [P, n]
+# slab row for per_peer ops), ``p`` the rank count, ``b`` the payload
+# element bytes.  Pinned against the registry by tests/test_compression.py.
+def op_wire_bytes(op_name: str, num_elements: int, num_ranks: int,
+                  elem_bytes: int,
+                  compression: Optional[str] = None) -> Optional[int]:
+    """Per-device analytic wire bytes for one registry op, or None for
+    ops without a wire model (the collective-matmul micro-ops, whose
+    wire depends on the schedule).  For the compressed ops the model
+    includes the fp32 scale side channel; ``compression`` defaults to
+    the op's default (int8)."""
+    n, p, b = num_elements, num_ranks, elem_bytes
+    if p <= 1:
+        return 0
+    if op_name in ("allreduce", "allreduce_hierarchical", "broadcast",
+                   "reduce", "barrier"):
+        return int(2 * (p - 1) / p * n * b)
+    if op_name in ("allgather", "gather", "alltoall"):
+        return int((p - 1) * n * b)
+    if op_name == "scatter":
+        # psum-broadcast of the root's whole [P, n] slab, then local slice
+        return int(2 * (p - 1) / p * p * n * b)
+    if op_name == "sendrecv":
+        return int(n * b)
+    if op_name == "reducescatter":
+        return int((p - 1) * n * b)
+    if op_name in ("allreduce_q", "reducescatter_q"):
+        # quantised payloads travel chunk-padded (padded_elems), scale
+        # side channel included
+        w = COMPRESSED_WIRE_ITEM_BYTES[compression or "int8"]
+        if op_name == "reducescatter_q":
+            # ring phase only: (P-1) hops of one quantised row + scales
+            return (p - 1) * (padded_elems(n) * w + scale_bytes(n))
+        # ring reduce-scatter of ceil(n/P)-element chunks, then the
+        # all-gather of the quantised reduced chunks (+ scale gathers)
+        c = -(-n // p)
+        ring = (p - 1) * (padded_elems(c) * w + scale_bytes(c))
+        gather = int(
+            (p - 1) / p * p * (padded_elems(c) * w + scale_bytes(c)))
+        return ring + gather
+    return None
+
+
+def compression_wire_ceiling(baseline_bytes: int, analytic_bytes: int,
+                             ratio: float = 0.55,
+                             slack: float = 1.1) -> int:
+    """The one compression total-wire ceiling, shared by every compressed
+    audit target (micro-ops AND the compressed train step — a contract
+    change here moves all of them together): the ``ratio`` x uncompressed
+    baseline contract, OR ``slack`` x the op's own padding-included
+    analytic wire where compression cannot pay (small/misaligned
+    payloads), whichever is larger."""
+    return max(int(ratio * baseline_bytes), int(slack * analytic_bytes))
+
+
+def compressed_op_expectation(op_name: str, p: int, num_elements: int,
+                              compression: str = "int8",
+                              baseline_elem_bytes: int = 2,
+                              ratio: float = 0.55) -> TargetExpectation:
+    """Expectation for a compressed registry op (``allreduce_q`` /
+    ``reducescatter_q``): the lowered module must be the quantised ring —
+    collective-permutes (plus, for allreduce_q, the wire-dtype all-gather
+    phase) — and its TOTAL analytic wire volume, scale side channel
+    included, must stay under ``ratio`` x the uncompressed bf16 wire of
+    the op it replaces.  The total ceiling is what proves XLA did not
+    dequantise before the collective: a bf16-wire ring moves ~2x and
+    fails it even though its instruction kinds look right.
+
+    At small/misaligned payloads the chunk padding + scale overhead can
+    legitimately exceed ``ratio`` x baseline (compression only pays above
+    ~SCALE_CHUNK_ELEMS elements per ring chunk), so the ceiling is the
+    MAX of the ratio contract and 1.1x the op's own analytic wire
+    (``op_wire_bytes``, padding included) — strict where compression is
+    meaningful, never rejecting a correct ring where it is not."""
+    w = COMPRESSED_WIRE_ITEM_BYTES[compression]
+    if op_name == "allreduce_q":
+        baseline = wire_bytes(
+            "all-reduce", num_elements * baseline_elem_bytes, p)
+        allowed = {"collective-permute", "all-gather"}
+        # largest legitimate instruction: the quantised all-gather result
+        # — P chunk-padded ring chunks
+        max_instr = p * padded_elems(-(-num_elements // p)) * w
+    elif op_name == "reducescatter_q":
+        baseline = wire_bytes(
+            "reduce-scatter", num_elements * baseline_elem_bytes, p)
+        allowed = {"collective-permute"}
+        max_instr = padded_elems(num_elements) * w
+    else:
+        raise ValueError(f"not a compressed registry op: {op_name!r}")
+    analytic = op_wire_bytes(op_name, num_elements, p, baseline_elem_bytes,
+                             compression=compression)
+    return TargetExpectation(
+        allowed=allowed,
+        required_any={"collective-permute"},
+        min_required=p - 1,
+        # a dequantised bf16 instruction would be 2x the wire width and
+        # trip this even before the total ceiling
+        max_bytes_per_instr=int(
+            max_instr * 1.25 + scale_bytes(num_elements) * p
+        ),
+        max_total_wire_bytes=compression_wire_ceiling(
+            baseline, analytic, ratio=ratio),
     )
 
 

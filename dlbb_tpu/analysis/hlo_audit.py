@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional, Sequence
 
 from dlbb_tpu.analysis.expectations import (
     TargetExpectation,
+    compressed_op_expectation,
     op_expectation,
     overlap_op_expectation,
     plan_expected_kinds,
@@ -122,6 +123,34 @@ def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
                     "present": [i.to_dict() for i in instrs],
                 },
             ))
+    total_wire = sum(
+        wire_bytes(i.kind, i.result_bytes, i.group_size) for i in instrs
+    )
+    if (exp.max_total_wire_bytes is not None
+            and total_wire > exp.max_total_wire_bytes):
+        findings.append(Finding(
+            pass_name="hlo",
+            rule="wire-volume-ceiling",
+            severity=SEVERITY_ERROR,
+            target=target.name,
+            message=(
+                f"total analytic wire volume {total_wire} B/device exceeds "
+                f"the ceiling of {exp.max_total_wire_bytes} B — for a "
+                "compressed collective this means the quantisation did "
+                "not reach the wire (XLA dequantised before the "
+                "collective, or an uncompressed reduction survived)"
+            ),
+            details={
+                "total_wire_bytes": total_wire,
+                "max_total_wire_bytes": exp.max_total_wire_bytes,
+                "per_instr_wire_bytes": [
+                    {"kind": i.kind,
+                     "wire_bytes": wire_bytes(
+                         i.kind, i.result_bytes, i.group_size)}
+                    for i in instrs
+                ],
+            },
+        ))
     if exp.expect_donation and not has_donation(lowered.as_text(),
                                                 compiled_text):
         findings.append(Finding(
@@ -140,6 +169,7 @@ def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
     meta = {
         "collectives": [i.to_dict() for i in instrs],
         "num_collectives": len(instrs),
+        "total_wire_bytes": total_wire,
     }
     return findings, meta
 
@@ -209,6 +239,38 @@ def _collective_matmul_target(op_name: str, schedule: str,
         name=f"comm/ops.py::{op_name}[{schedule}]",
         build=build,
         expectation=exp,
+        min_devices=num_ranks,
+    )
+
+
+def _compressed_op_target(op_name: str, compression: str,
+                          num_ranks: int = 8,
+                          num_elements: int = 4096) -> AuditTarget:
+    """One audit target per (compressed micro-op, wire dtype).  The
+    expectation is the compression proof: a pure quantised ring (plus the
+    wire-dtype gather phase for allreduce_q) whose TOTAL analytic wire —
+    scale side channel included — stays under 0.55x the uncompressed
+    bf16 wire (``expectations.compressed_op_expectation``,
+    docs/compression.md)."""
+    import jax.numpy as jnp
+
+    def build():
+        from dlbb_tpu.comm.mesh import MeshSpec, build_mesh
+        from dlbb_tpu.comm.ops import get_op, make_payload
+
+        op = get_op(op_name)
+        mesh = build_mesh(MeshSpec.ring(num_ranks))
+        fn = op.build(mesh, ("ranks",), compression=compression)
+        # bf16 payload: the baseline the 0.55x ceiling is priced against
+        x = make_payload(op, mesh, ("ranks",), num_elements,
+                         dtype=jnp.bfloat16)
+        return fn, (x,)
+
+    return AuditTarget(
+        name=f"comm/ops.py::{op_name}[{compression}]",
+        build=build,
+        expectation=compressed_op_expectation(
+            op_name, num_ranks, num_elements, compression=compression),
         min_devices=num_ranks,
     )
 
@@ -467,6 +529,74 @@ def _tp_overlap_train_target(schedule: str, dp: int = 2,
     )
 
 
+def _compressed_train_target(compression: str = "int8",
+                             dp: int = 8) -> AuditTarget:
+    """The compressed DDP train step (training.grad_compression): the dp
+    gradient reduction must be the quantised ring — collective-permutes
+    plus the wire-dtype all-gather — with the only all-reduce the scalar
+    loss mean, the error-feedback residual donated with the rest of the
+    state, and the TOTAL analytic wire (scales included) under 0.55x the
+    bf16 baseline's ``2(P-1)/P x 2 bytes x n_params``.  This is the
+    acceptance gate proving XLA did not dequantise before the wire."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import optax
+        from jax.sharding import NamedSharding
+
+        from dlbb_tpu.comm.mesh import build_parallelism_mesh
+        from dlbb_tpu.models.configs import ModelConfig
+        from dlbb_tpu.models.sharding import batch_spec
+        from dlbb_tpu.models.transformer import init_params_sharded
+        from dlbb_tpu.train.loop import make_train_step
+
+        cfg = ModelConfig(**_TINY_MODEL)
+        mesh = build_parallelism_mesh(data_parallel=dp)
+        params = init_params_sharded(cfg, jax.random.key(0), mesh)
+        jit_step, state = make_train_step(
+            cfg, mesh, optax.adam(1e-3), params, zero_stage=0,
+            grad_compression=compression,
+        )
+        sharding = NamedSharding(mesh, batch_spec(mesh))
+        batch = jax.device_put(
+            jnp.ones((dp, 8, cfg.hidden_size), jnp.float32), sharding)
+        tgt = jax.device_put(
+            jnp.ones((dp, 8, cfg.hidden_size), jnp.float32), sharding)
+        return jit_step, (state, batch, tgt)
+
+    from dlbb_tpu.analysis.expectations import (
+        compression_wire_ceiling,
+        op_wire_bytes,
+        scale_bytes,
+    )
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.models.transformer import num_parameters
+
+    n_params = num_parameters(ModelConfig(**_TINY_MODEL))
+    baseline = wire_bytes("all-reduce", n_params * 2, dp)  # bf16 ring AR
+    # the grads ride as one flat allreduce_q-shaped reduction; the
+    # ceiling is the shared contract of compression_wire_ceiling
+    analytic = op_wire_bytes("allreduce_q", n_params, dp, 2,
+                             compression=compression)
+    return AuditTarget(
+        name=f"train/loop.py::train_step[ddp,compressed={compression}]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=dp, compression=compression),
+            required_any={"collective-permute"},
+            min_required=dp - 1,
+            # largest legitimate instruction: the quantised flat-grad
+            # all-gather (~n_params wire bytes, chunk-padded)
+            max_bytes_per_instr=int(
+                n_params * 1.25 + scale_bytes(n_params) * dp),
+            max_total_wire_bytes=compression_wire_ceiling(
+                baseline, analytic),
+            expect_donation=True,
+        ),
+        min_devices=dp,
+    )
+
+
 def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
     def build():
         import jax
@@ -510,17 +640,25 @@ def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
 def registry_op_targets() -> list[AuditTarget]:
     """One audit target per ``comm/ops.py`` registry collective — the
     collective-matmul micro-ops need LLM-shaped payloads and get one
-    dedicated target per schedule (fused vs the decomposed rings)."""
-    from dlbb_tpu.comm.ops import MATMUL_OPS, OPERATIONS
+    dedicated target per schedule (fused vs the decomposed rings); the
+    compressed micro-ops get one per wire dtype, audited against the
+    compression byte ceiling instead of the plain kind table."""
+    from dlbb_tpu.comm.ops import COMPRESSED_OPS, MATMUL_OPS, OPERATIONS
 
     targets = [
         _registry_op_target(name)
-        for name in sorted(OPERATIONS) if name not in MATMUL_OPS
+        for name in sorted(OPERATIONS)
+        if name not in MATMUL_OPS and name not in COMPRESSED_OPS
     ]
     targets += [
         _collective_matmul_target(name, schedule)
         for name in MATMUL_OPS
         for schedule in ("fused", "ring", "bidir")
+    ]
+    targets += [
+        _compressed_op_target(name, compression)
+        for name in COMPRESSED_OPS
+        for compression in ("int8", "fp8")
     ]
     return targets
 
@@ -540,6 +678,7 @@ def default_targets() -> list[AuditTarget]:
     targets.append(_train_step_target(zero_stage=0))
     targets.append(_train_step_target(zero_stage=1))
     targets.append(_tp_overlap_train_target("ring"))
+    targets.append(_compressed_train_target("int8"))
     return targets
 
 

@@ -40,6 +40,7 @@ import numpy as np
 from dlbb_tpu.bench import schedule
 from dlbb_tpu.comm.mesh import get_mesh
 from dlbb_tpu.comm.ops import (
+    COMPRESSED_OPS,
     MATMUL_OPS,
     build_allreduce_hierarchical,
     get_op,
@@ -275,6 +276,17 @@ def _build_fn(op_name: str, variant: Variant, mesh, axes, root: int):
         return get_op(op_name).build(
             mesh, axes, root, schedule=variant.overlap_schedule
         )
+    if op_name in COMPRESSED_OPS and (
+            variant.compression is not None
+            or variant.accum_dtype is not None):
+        # quantised-wire knobs (docs/compression.md) — dispatch like the
+        # overlap schedule above; unset fields keep the op defaults
+        kwargs: dict[str, Any] = {}
+        if variant.compression is not None:
+            kwargs["compression"] = variant.compression
+        if variant.accum_dtype is not None:
+            kwargs["accum_dtype"] = _dtype_of(variant.accum_dtype)
+        return get_op(op_name).build(mesh, axes, root, **kwargs)
     return get_op(op_name).build(mesh, axes, root)
 
 
@@ -478,6 +490,15 @@ def _run_sweep_configured(
         # filesystem would interleave duplicate lines
         enabled=sweep.journal and jax.process_index() == 0,
     )
+    # topology fingerprint (ROADMAP item 5 standing chore): which fabric
+    # this sweep actually measured, journaled + manifested — a degraded
+    # CPU fallback is a durable record, never just a log line
+    from dlbb_tpu.utils.simulate import topology_record
+
+    topology = topology_record()
+    journal.event("topology", **topology)
+    if topology["degraded"] and verbose:
+        print(f"[topology] DEGRADED backend: {topology.get('degraded_reason')}")
     # ---- planning pass -------------------------------------------------
     plan: list[_Planned] = []
     units: "dict[tuple, schedule.WorkUnit]" = {}
@@ -719,6 +740,7 @@ def _run_sweep_configured(
             "kind": sweep.kind,
             "implementation": impl,
             "variant": variant.name,
+            "topology": topology,
             "timing_mode": mode,
             "pipeline": scheduler.pipelined,
             "prefetch": sweep.prefetch,
@@ -985,6 +1007,10 @@ def _run_one(
         **timing_meta,
         "timings": timings,
         "variant": variant.name,
+        # wire compression of the quantised micro-ops (docs/compression.md)
+        # — consumed by the stats pipeline's analytic bytes_on_wire column
+        **({"compression": variant.compression or "int8"}
+           if op_name in COMPRESSED_OPS else {}),
         **dict(variant.extra),
         "mesh_shape": list(mesh.devices.shape),
         "mesh_axis_names": list(mesh.axis_names),

@@ -184,6 +184,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--tp-overlap", default=None,
                     choices=("off", "ring", "bidir"), dest="tp_overlap",
                     help="override model.tp_overlap (see the e2e flag)")
+    tr.add_argument("--grad-compression", default=None,
+                    choices=("none", "int8", "fp8"), dest="grad_compression",
+                    help="override training.grad_compression: quantise "
+                         "the dp gradient reduction to an int8/fp8 wire "
+                         "with an error-feedback residual "
+                         "(docs/compression.md)")
     _add_trace(tr)
 
     return ap
@@ -466,6 +472,7 @@ def _dispatch(args) -> int:
         result = run_train_from_config(
             args.config, zero1=args.zero1, zero_stage=args.zero_stage,
             output_dir=args.output, tp_overlap=args.tp_overlap,
+            grad_compression=args.grad_compression,
         )
         if result.get("preempted") and "step_time" not in result:
             print(f"preempted at step {result['preempted_at_step']}; "

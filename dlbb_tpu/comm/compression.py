@@ -1,0 +1,260 @@
+"""Compressed collectives: quantised gradient reduction on the wire.
+
+The tuning axis the rest of the framework sweeps (mesh shape, combiner
+thresholds, overlap schedules — ``comm/variants.py``) only reorders *how*
+bytes move; this module moves *fewer* bytes, following the compressed-SGD
+line (Seide et al. 2014 1-bit SGD; Vogels et al. 2019 PowerSGD): quantise
+the wire to int8 or fp8(e4m3), carry the quantisation error in an
+error-feedback residual so training still converges.
+
+Wire format (docs/compression.md)
+---------------------------------
+Chunked symmetric quantisation: the flat payload is split into
+``SCALE_CHUNK_ELEMS``-element chunks; each chunk carries one fp32 scale
+``amax(chunk) / qmax`` computed ON DEVICE (qmax = 127 for int8, 448 for
+fp8 e4m3) and its values quantised to the wire dtype.  The scale tensor
+is the side channel: it travels alongside every quantised hop and is
+charged to the byte accounting (``analysis/expectations.py::
+op_wire_bytes``; the comm-lint ceiling includes it).
+
+Compressed reductions
+---------------------
+``psum_compressed`` is an all-to-all-free ring: quantise → ring
+reduce-scatter in the wire dtype (each hop dequantises the incoming
+partial into the accumulation dtype, adds the local chunk, re-quantises
+for the next hop) → all-gather of the quantised reduced chunks →
+dequantise.  ``reduce_scatter_compressed`` is the same ring without the
+gather phase.  Both accept ``accum_dtype`` (fp32 default, bf16 variant)
+— the bf16-vs-fp32 accumulation axis the sweep engine prices.
+
+Error-feedback contract
+-----------------------
+The residual fed back by the train loop (``train/loop.py``) is the error
+of the LOCAL quantiser: ``e ← c − D(Q(c))`` where ``c = grad + e_prev``
+(:func:`quantization_error`).  Per-hop re-quantisation error inside the
+ring is second-order (one extra rounding per hop on an already-quantised
+partial) and is NOT fed back — documented, and bounded by the
+``psum_compressed == psum`` tolerance tests (``tests/test_compression.py``).
+
+Everything here is a *local* function meant to run inside ``shard_map``
+(the global-array builders live in ``comm/ops.py``:
+``build_allreduce_q`` / ``build_reducescatter_q``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# The scale-chunk granularity is shared with the analytic wire model in
+# dlbb_tpu/analysis/expectations.py (which must stay importable without
+# jax — hence the constants live THERE and are imported here, not the
+# other way around).
+from dlbb_tpu.analysis.expectations import (
+    COMPRESSIONS,
+    SCALE_CHUNK_ELEMS,
+)
+from dlbb_tpu.compat import axis_size
+
+# Symmetric quantisation ranges: int8 uses the full signed byte minus the
+# asymmetric -128 (so the grid is symmetric around 0); fp8 e4m3's finite
+# max is 448.
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+
+
+def _wire_dtype(compression: str):
+    if compression == "int8":
+        return jnp.int8
+    if compression == "fp8":
+        return jnp.float8_e4m3fn
+    raise ValueError(
+        f"unknown compression {compression!r}; known: {COMPRESSIONS}"
+    )
+
+
+def check_compression(compression: str) -> str:
+    """Validate (and return) a compression name — the one gate every
+    entry point shares, so an unknown name fails with the known set."""
+    _wire_dtype(compression)
+    return compression
+
+
+def quantize_chunked(
+    x: jax.Array, compression: str = "int8",
+) -> tuple[jax.Array, jax.Array]:
+    """Chunked symmetric quantisation of a flat (last-axis) payload.
+
+    Returns ``(q, scales)``: ``q`` is ``[..., n_chunks, SCALE_CHUNK_ELEMS]``
+    in the wire dtype (zero-padded to a chunk multiple), ``scales`` is
+    ``[..., n_chunks]`` fp32.  Scales are computed on device from the
+    chunk amax — no host round-trip inside a timed region.
+    """
+    dtype = _wire_dtype(compression)
+    qmax = _QMAX[compression]
+    n = x.shape[-1]
+    pad = (-n) % SCALE_CHUNK_ELEMS
+    xf = x.astype(jnp.float32)
+    if pad:
+        widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        xf = jnp.pad(xf, widths)
+    chunks = xf.reshape(xf.shape[:-1] + (-1, SCALE_CHUNK_ELEMS))
+    amax = jnp.max(jnp.abs(chunks), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0.0, amax / qmax, 1.0)
+    q = chunks / scale
+    if compression == "int8":
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    return q.astype(dtype), scale.squeeze(-1).astype(jnp.float32)
+
+
+def dequantize_chunked(
+    q: jax.Array, scales: jax.Array, num_elements: int,
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """Inverse of :func:`quantize_chunked`: ``[..., n_chunks, C]`` wire
+    payload + ``[..., n_chunks]`` scales → flat ``[..., num_elements]``
+    (padding stripped) in ``out_dtype``."""
+    x = q.astype(jnp.float32) * scales[..., None]
+    x = x.reshape(x.shape[:-2] + (-1,))[..., :num_elements]
+    return x.astype(out_dtype)
+
+
+def _to_wire(q: jax.Array, compression: str) -> jax.Array:
+    """Bitcast the quantised payload to a raw byte dtype for the
+    collective.  XLA's float-normalization legalises fp8 arithmetic types
+    to f16 on backends without native fp8 support (observed on this
+    jaxlib's CPU backend) — which would silently DOUBLE the wire and trip
+    the comm-lint byte ceiling.  int8 is a collective-native type on
+    every backend; the bitcast costs nothing and pins the wire width."""
+    if compression == "fp8":
+        return lax.bitcast_convert_type(q, jnp.int8)
+    return q
+
+
+def _from_wire(w: jax.Array, compression: str) -> jax.Array:
+    if compression == "fp8":
+        return lax.bitcast_convert_type(w, jnp.float8_e4m3fn)
+    return w
+
+
+def quantization_error(x: jax.Array, compression: str = "int8") -> jax.Array:
+    """``x − D(Q(x))`` — the local quantiser's error, which IS the
+    error-feedback residual the train loop carries in optimizer state
+    (the Seide-style compressor-error estimate; see module docstring for
+    why hop re-quantisation error is excluded)."""
+    q, s = quantize_chunked(x, compression)
+    return (x.astype(jnp.float32)
+            - dequantize_chunked(q, s, x.shape[-1], jnp.float32)
+            ).astype(x.dtype)
+
+
+def _ring_reduce(
+    local_chunk: Callable[[int], jax.Array],
+    axis_name: str,
+    p: int,
+    compression: str,
+    accum_dtype,
+) -> jax.Array:
+    """The shared quantised accumulating ring.
+
+    ``local_chunk(s)`` must return this device's contribution for the
+    travelling accumulator at unrolled step ``s`` (the accumulator keeps
+    its chunk identity as it moves: the chunk that ends on this device
+    visits every rank exactly once).  Each hop ppermutes the quantised
+    partial AND its scale tensor (two collective-permutes per hop — the
+    scale side channel is real wire traffic and is audited as such).
+    """
+    fwd = [(i, (i + 1) % p) for i in range(p)]
+    part = local_chunk(0).astype(accum_dtype)
+    for s in range(1, p):
+        q, scales = quantize_chunked(part, compression)
+        q = _from_wire(
+            lax.ppermute(_to_wire(q, compression), axis_name, fwd),
+            compression,
+        )
+        scales = lax.ppermute(scales, axis_name, fwd)
+        incoming = dequantize_chunked(
+            q, scales, part.shape[-1], accum_dtype
+        )
+        part = incoming + local_chunk(s).astype(accum_dtype)
+    return part
+
+
+def psum_compressed(
+    x: jax.Array,
+    axis_name: str,
+    compression: str = "int8",
+    accum_dtype=jnp.float32,
+) -> jax.Array:
+    """Quantised all-reduce over ``axis_name`` (call inside shard_map).
+
+    Ring reduce-scatter in the wire dtype, then an all-gather of the
+    quantised reduced chunks — total wire ≈ ``2(P−1)/P × n`` wire-dtype
+    bytes + scales, vs ``2(P−1)/P × n × 2`` for the bf16 ring all-reduce
+    the audit uses as its baseline.  Output has ``x``'s shape and dtype;
+    accumulation runs in ``accum_dtype``.
+    """
+    check_compression(compression)
+    p = axis_size(axis_name)
+    if p == 1:
+        return x
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    c = -(-n // p)  # ring-chunk elements
+    if p * c != n:
+        flat = jnp.pad(flat, (0, p * c - n))
+    chunks = flat.reshape(p, c)
+    r = lax.axis_index(axis_name)
+    # init with chunk (r-1): the accumulator that ends here is chunk r,
+    # so the gathered rows below land in order (row k == chunk k)
+    part = _ring_reduce(
+        lambda s: lax.dynamic_index_in_dim(
+            chunks, (r - 1 - s) % p, axis=0, keepdims=False),
+        axis_name, p, compression, accum_dtype,
+    )
+    q, scales = quantize_chunked(part, compression)
+    gq = _from_wire(
+        lax.all_gather(_to_wire(q, compression), axis_name), compression,
+    )                                          # [P, n_chunks, C] wire dtype
+    gs = lax.all_gather(scales, axis_name)     # [P, n_chunks] fp32
+    rows = dequantize_chunked(gq, gs, c, accum_dtype)  # [P, c]
+    out = rows.reshape(-1)[:n].reshape(orig_shape)
+    return out.astype(orig_dtype)
+
+
+def reduce_scatter_compressed(
+    rows: jax.Array,
+    axis_name: str,
+    compression: str = "int8",
+    accum_dtype=jnp.float32,
+) -> jax.Array:
+    """Quantised reduce-scatter (call inside shard_map).
+
+    ``rows`` is this device's ``[P, *chunk]`` slab — row ``k`` is the
+    contribution destined to rank ``k`` (the registry's ``per_peer``
+    layout).  Returns this rank's fully-reduced chunk; wire is the ring
+    phase of :func:`psum_compressed` alone: ``(P−1)`` hops of one
+    wire-dtype chunk + scales.
+    """
+    check_compression(compression)
+    p = axis_size(axis_name)
+    chunk_shape = rows.shape[1:]
+    if rows.shape[0] != p:
+        raise ValueError(
+            f"reduce_scatter_compressed: leading dim {rows.shape[0]} must "
+            f"equal the axis size {p}"
+        )
+    if p == 1:
+        return rows[0]
+    flat_rows = rows.reshape(p, -1)
+    n = flat_rows.shape[-1]
+    r = lax.axis_index(axis_name)
+    part = _ring_reduce(
+        lambda s: lax.dynamic_index_in_dim(
+            flat_rows, (r - 1 - s) % p, axis=0, keepdims=False),
+        axis_name, p, compression, accum_dtype,
+    )
+    return part[:n].reshape(chunk_shape).astype(rows.dtype)

@@ -258,6 +258,51 @@ def build_reducescatter(mesh, axes, root=0):
     return _wrap(mesh, axes, body, 3, 3)
 
 
+# Compressed (quantised-wire) micro-ops, in one place like MATMUL_OPS:
+# the runner's variant dispatch and the HLO audit's compressed targets
+# both key off this tuple (docs/compression.md).
+COMPRESSED_OPS = ("allreduce_q", "reducescatter_q")
+
+
+def build_allreduce_q(mesh, axes, root=0, compression="int8",
+                      accum_dtype=jnp.float32):
+    """Quantised all-reduce: ring reduce-scatter in the wire dtype +
+    all-gather of the quantised reduced chunks
+    (``comm/compression.py::psum_compressed``).  Same [P, n] payload
+    contract as ``allreduce``, so the sweep engine prices compressed vs
+    fused on identical logical payloads; ``compression``/``accum_dtype``
+    are the ``Variant.compression``/``Variant.accum_dtype`` knobs."""
+    if len(axes) != 1:
+        raise ValueError("allreduce_q requires a single mesh axis")
+    from dlbb_tpu.comm.compression import psum_compressed
+
+    def body(x):  # local [1, n]
+        out = psum_compressed(
+            x[0], axes[0], compression=compression, accum_dtype=accum_dtype
+        )
+        return out[None].astype(x.dtype)
+
+    return _wrap(mesh, axes, body, 2, 2)
+
+
+def build_reducescatter_q(mesh, axes, root=0, compression="int8",
+                          accum_dtype=jnp.float32):
+    """Quantised reduce-scatter: the ring phase of ``allreduce_q`` alone
+    (``comm/compression.py::reduce_scatter_compressed``).  Same
+    ``per_peer`` [P, P, n] payload contract as ``reducescatter``."""
+    if len(axes) != 1:
+        raise ValueError("reducescatter_q requires a single mesh axis")
+    from dlbb_tpu.comm.compression import reduce_scatter_compressed
+
+    def body(x):  # local [1, P, n] -> [1, 1, n]
+        out = reduce_scatter_compressed(
+            x[0], axes[0], compression=compression, accum_dtype=accum_dtype
+        )
+        return out[None, None].astype(x.dtype)
+
+    return _wrap(mesh, axes, body, 3, 3)
+
+
 def _synth_weight(rows: int, cols: int, dtype, row_offset=0, col_offset=0):
     """Deterministic dense weight generated ON DEVICE (broadcasted iota +
     cosine) — a host-side constant at these sizes would be embedded in the
@@ -498,6 +543,18 @@ OPERATIONS: dict[str, CollectiveOp] = {
     "matmul_rs": CollectiveOp(
         "matmul_rs", "per_rank", "per_rank", build_matmul_rs,
         _chain_matmul_rs, transient_kind="per_rank",
+    ),
+    # Quantised-wire collectives (docs/compression.md): the default build
+    # is int8 with fp32 accumulation; the compress_* variants
+    # (comm/variants.py) select fp8 / bf16-accum so the sweep engine
+    # measures fused-vs-compressed on identical payloads.
+    "allreduce_q": CollectiveOp(
+        "allreduce_q", "per_rank", "per_rank", build_allreduce_q,
+        _chain_rescale,
+    ),
+    "reducescatter_q": CollectiveOp(
+        "reducescatter_q", "per_peer", "per_rank", build_reducescatter_q,
+        _chain_scatter_back,
     ),
 }
 

@@ -47,6 +47,14 @@ class Variant:
     # dlbb_tpu/parallel/collective_matmul.py.  Ignored by every other op
     # (a tuning knob, like `hierarchical` for allreduce).
     overlap_schedule: Optional[str] = None
+    # wire compression for the allreduce_q / reducescatter_q micro-ops:
+    # None = the op's default (int8); "int8" / "fp8" select the wire
+    # dtype explicitly (dlbb_tpu/comm/compression.py, docs/compression.md).
+    # Ignored by every other op, same convention as `overlap_schedule`.
+    compression: Optional[str] = None
+    # accumulation dtype for the compressed ring ("float32" default;
+    # "bfloat16" is the memory/speed-reduced variant the sweep prices)
+    accum_dtype: Optional[str] = None
     # XLA_FLAGS fragments a launcher must set before process start
     xla_flags: tuple[str, ...] = ()
     # per-computation XLA compiler options (jit(...).lower().compile(...)),
@@ -168,6 +176,26 @@ VARIANTS: dict[str, Variant] = {
         "step — half the hops for ag_matmul, half-sized messages both "
         "ways for matmul_rs",
         overlap_schedule="bidir",
+    ),
+    "compress_int8": Variant(
+        "compress_int8",
+        "quantised-wire collectives: int8 chunked-symmetric wire, fp32 "
+        "accumulation (allreduce_q / reducescatter_q micro-ops; bf16 "
+        "fused baseline = the default variant on allreduce/reducescatter)",
+        compression="int8",
+    ),
+    "compress_fp8": Variant(
+        "compress_fp8",
+        "quantised-wire collectives: fp8(e4m3) wire, fp32 accumulation — "
+        "same byte footprint as int8, different rounding behaviour",
+        compression="fp8",
+    ),
+    "compress_int8_bf16acc": Variant(
+        "compress_int8_bf16acc",
+        "int8 wire with bf16 ring accumulation — the reduced-precision "
+        "accumulate leg of the bandwidth-vs-accuracy axis",
+        compression="int8",
+        accum_dtype="bfloat16",
     ),
     "nofuse": Variant(
         "nofuse",

@@ -50,7 +50,13 @@ NOT_COMPARABLE = "not_comparable(simulated)"
 COLUMNS_1D = [
     "operation", "data_size_name", "num_ranks", "xla_dtype",
     "ref_best_backend", "ref_best_mean_us", "ref_best_bandwidth_gbps",
-    "xla_mean_us", "xla_bandwidth_gbps", "speedup", "verdict",
+    "xla_mean_us", "xla_bandwidth_gbps",
+    # analytic per-device wire bytes of the own-side implementation
+    # (stats1d carries it per row): bandwidth columns normalise by
+    # LOGICAL payload, so this is where a compressed row's wire saving
+    # is visible next to its uncompressed baseline (docs/compression.md)
+    "xla_bytes_on_wire",
+    "speedup", "verdict",
     "raw_verdict",
 ]
 
@@ -160,6 +166,7 @@ def compare_1d(
                 round(r["bandwidth_gbps"], 4)
                 if r["bandwidth_gbps"] is not None else None
             ),
+            "xla_bytes_on_wire": r.get("bytes_on_wire"),
             "speedup": round(speedup, 4),
             **_verdict_pair(speedup, r.get("backend")),
         })

@@ -70,8 +70,15 @@ CSV_COLUMNS = [
     # - dtype: the measured element type; the corpus carries the north-star
     #   curve in BOTH bf16 and fp32 (BASELINE.json configs[1]), so rows are
     #   keyed by (op, size, ranks, dtype)
+    # - bytes_on_wire: analytic per-device wire bytes of the op's
+    #   implementation (dlbb_tpu.analysis.expectations.op_wire_bytes;
+    #   blank for ops without a wire model).  bandwidth_gbps stays the
+    #   reference's LOGICAL-payload formula, so compressed-vs-uncompressed
+    #   curves normalise by logical bytes and this column shows the wire
+    #   saving (docs/compression.md)
     "timing_granularity",
     "dtype",
+    "bytes_on_wire",
 ]
 
 
@@ -162,6 +169,17 @@ def process_file(
         data["num_ranks"],
         algorithm_bandwidth=algorithm_bandwidth,
     )
+    # analytic wire volume (dlbb_tpu.analysis.expectations — jax-free, so
+    # the stats path stays backend-free): lets compressed-vs-uncompressed
+    # bus-bandwidth curves normalise by LOGICAL payload bytes (the
+    # bandwidth column above) while still showing the wire saving
+    from dlbb_tpu.analysis.expectations import op_wire_bytes
+
+    wire = op_wire_bytes(
+        data["operation"], data["num_elements"], data["num_ranks"],
+        _DTYPE_BYTES.get(data.get("dtype", "bfloat16"), 2),
+        compression=data.get("compression"),
+    )
     out = {
         "mpi_implementation": impl,
         "operation": data["operation"],
@@ -171,6 +189,7 @@ def process_file(
         "dtype": data.get("dtype", ""),
         **stats,
         "bandwidth_gbps": bandwidth,
+        "bytes_on_wire": wire,
         # reference artifacts (and per_iter runs) have no granularity
         # marker: their timing rows are genuine per-iteration samples
         "timing_granularity": data.get("timing_granularity",

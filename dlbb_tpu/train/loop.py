@@ -202,6 +202,9 @@ def make_train_step(
     moe_aux_weight: float = 0.0,
     grad_accum: int = 1,
     pipeline_schedule: str = "gpipe",
+    grad_compression: str = "none",
+    compression_accum: str = "float32",
+    residual_dtype: Any = None,
 ):
     """Build (jitted step fn, initial sharded TrainState) for the given
     ZeRO stage (0=DDP, 1=opt-state sharding, 2=+grad sharding, 3=FSDP).
@@ -214,6 +217,19 @@ def make_train_step(
     splits the batch into that many sequential micro-steps whose mean
     gradient feeds one optimizer update (same numerics as the full batch
     for mean losses, 1/grad_accum the activation memory).
+
+    ``grad_compression`` ("int8"/"fp8", docs/compression.md) swaps the
+    dp gradient reduction for the quantised ring of
+    ``comm/compression.py``: local grads are computed inside a
+    full-manual shard_map (no GSPMD all-reduce exists to begin with),
+    the error-feedback residual is added, and the compressed
+    ``psum_compressed`` reduces on an int8/fp8 wire.  The residual lives
+    as an extra optimizer-state leaf
+    (``train/optim.py::GradCompressionState`` — dp-sharded, checkpointed,
+    stored in ``residual_dtype``); ``compression_accum`` picks the ring's
+    accumulation precision.  Supported envelope: pure-dp meshes (every
+    other axis size 1), ZeRO stages 0/2, dense attention, no grad
+    accumulation / MoE aux loss — violations raise here, at build time.
 
     The returned step donates its state argument, and the ``device_put``
     here may alias the caller's ``params`` buffers — treat the input
@@ -233,6 +249,69 @@ def make_train_step(
         )
     stage = resolve_zero_stage(zero1, zero_stage)
     dp_size = mesh.shape.get("dp", 1)
+    from dlbb_tpu.train.optim import (
+        GRAD_COMPRESSIONS,
+        GradCompressionState,
+        init_error_feedback,
+    )
+
+    if grad_compression not in GRAD_COMPRESSIONS:
+        raise ValueError(
+            f"unknown grad_compression {grad_compression!r}; known: "
+            f"{GRAD_COMPRESSIONS}"
+        )
+    compression_on = grad_compression != "none"
+    if compression_on:
+        # the compressed path computes LOCAL grads inside a full-manual
+        # shard_map and owns the reduction; every capability outside that
+        # envelope is rejected at build time, not at trace time
+        other = [a for a in mesh.axis_names
+                 if a != "dp" and mesh.shape[a] > 1]
+        if other:
+            raise ValueError(
+                "training.grad_compression requires a pure data-parallel "
+                f"mesh; axes {other} have size > 1 (compose compression "
+                "with tp/sp/pp is future work — docs/compression.md)"
+            )
+        if dp_size <= 1:
+            raise ValueError(
+                "training.grad_compression with data_parallel=1 has no "
+                "gradient reduction to compress: the ring is an identity, "
+                "so the error-feedback residual would subtract a "
+                "quantisation error that was never incurred — run "
+                "uncompressed, or use a dp>1 mesh"
+            )
+        if stage not in (0, 2):
+            raise ValueError(
+                "training.grad_compression supports ZeRO stages 0 (DDP) "
+                f"and 2 (grad sharding), not stage {stage}: stages 1/3 "
+                "shard the optimizer update itself, which the compressed "
+                "replicated-update path does not compose with"
+            )
+        # NOTE stage 2 + compression trades ZeRO-2's grad-MEMORY saving
+        # for the wire saving: the ring's gather phase transiently
+        # materialises the replicated flat gradient on every rank (DDP
+        # peak) before the layout pin slices it back to shards — a
+        # sharded-update path on reduce_scatter_compressed alone is the
+        # future-work alternative (docs/compression.md)
+        if grad_accum != 1:
+            raise ValueError(
+                "training.grad_compression does not compose with "
+                "gradient_accumulation yet (accumulate locally before "
+                "one compressed reduction is future work)"
+            )
+        if moe_aux_weight != 0.0:
+            raise ValueError(
+                "training.grad_compression does not support the MoE aux "
+                "loss (expert-parallel compression is future work)"
+            )
+        if config.attention not in ("full", "simplified", "dense"):
+            raise ValueError(
+                f"training.grad_compression requires a dense attention "
+                f"mode (full/simplified/dense), got "
+                f"{config.attention!r}: shard_map attention modes nest "
+                "their own manual meshes"
+            )
     base_specs = specs_for_mesh(mesh, moe=config.is_moe)
     dp_specs = dp_sharded_param_specs(params, dp_size, base_specs=base_specs)
     p_spec_tree = dp_specs if stage >= 3 else base_specs
@@ -247,6 +326,21 @@ def make_train_step(
         lambda s: NamedSharding(mesh, s), s_specs, is_leaf=_is_spec
     )
     opt_state = jax.device_put(opt_state, s_shardings)
+    if compression_on:
+        # error-feedback residual rides as an optimizer-state leaf: one
+        # [1, total_params] row per dp rank (P("dp") — per-device memory
+        # is 1x the flat grads, never replicated), checkpointed with the
+        # rest of the state, stored in residual_dtype (= moments_dtype
+        # under the memory-reduced-Adam convention)
+        res_dtype = jnp.dtype(residual_dtype) if residual_dtype is not None \
+            else jnp.float32
+        comp_shardings = GradCompressionState(
+            residual=NamedSharding(mesh, P("dp"))
+        )
+        comp = init_error_feedback(params, dp_size, res_dtype,
+                                   sharding=comp_shardings.residual)
+        opt_state = (opt_state, comp)
+        s_shardings = (s_shardings, comp_shardings)
     state = TrainState(params, opt_state, jnp.zeros((), jnp.int32))
 
     state_shardings = TrainState(
@@ -340,15 +434,83 @@ def make_train_step(
         )
         return loss_sum * inv, grads
 
-    def step(state: TrainState, batch, targets):
-        loss, grads = loss_and_grads(state.params, batch, targets)
-        if stage >= 2:
-            # pin grads to the dp-sharded layout: the dp all-reduce lowers
-            # to reduce-scatter and grad memory stays sharded (ZeRO-2)
-            grads = jax.lax.with_sharding_constraint(grads, grad_shardings)
-        updates, new_opt = optimizer.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        return TrainState(new_params, new_opt, state.step + 1), loss
+    if compression_on:
+        from jax.flatten_util import ravel_pytree
+
+        from dlbb_tpu.comm.compression import (
+            psum_compressed,
+            quantization_error,
+        )
+        from dlbb_tpu.compat import shard_map
+
+        accum = (jnp.bfloat16 if compression_accum == "bfloat16"
+                 else jnp.float32)
+        bspec = batch_spec(mesh)
+        # params enter the shard_map replicated (full value per device:
+        # every non-dp axis is size 1 and params are dp-replicated)
+        local_p_specs = jax.tree.map(lambda _: P(), params)
+
+        def _compressed_body(p, b, t, res):
+            # local loss/grads: the batch shard never crosses dp here, so
+            # no GSPMD gradient all-reduce exists to begin with — the
+            # ONLY gradient reduction is the quantised ring below
+            loss, g = jax.value_and_grad(mse_loss)(
+                p, b, t, config, None, None, 0.0
+            )
+            flat_g, unravel = ravel_pytree(g)
+            c = flat_g.astype(jnp.float32) + res[0].astype(jnp.float32)
+            reduced = psum_compressed(
+                c, "dp", compression=grad_compression, accum_dtype=accum
+            ) / dp_size
+            # Seide-style error feedback: carry the LOCAL quantiser's
+            # error into the next step (docs/compression.md)
+            new_res = quantization_error(c, grad_compression)
+            loss = jax.lax.psum(loss, "dp") / dp_size
+            return (loss, unravel(reduced.astype(flat_g.dtype)),
+                    new_res.astype(res.dtype)[None])
+
+        compressed_loss_and_grads = shard_map(
+            _compressed_body, mesh=mesh,
+            in_specs=(local_p_specs, bspec, bspec, P("dp")),
+            out_specs=(P(), local_p_specs, P("dp")),
+            # the ppermute ring defeats static replication inference for
+            # the replicated outputs; correctness is pinned by
+            # tests/test_compression.py (psum_compressed == psum)
+            check_vma=False,
+        )
+
+        def step(state: TrainState, batch, targets):
+            inner_state, comp = state.opt_state
+            loss, grads, new_res = compressed_loss_and_grads(
+                state.params, batch, targets, comp.residual
+            )
+            if stage >= 2:
+                # the reduction wire is already compressed; the ZeRO-2
+                # layout pin keeps grad memory dp-sharded downstream
+                # (replicated -> sharded is a local slice, no collective)
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_shardings)
+            updates, new_inner = optimizer.update(
+                grads, inner_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            return TrainState(
+                new_params,
+                (new_inner, GradCompressionState(residual=new_res)),
+                state.step + 1,
+            ), loss
+    else:
+        def step(state: TrainState, batch, targets):
+            loss, grads = loss_and_grads(state.params, batch, targets)
+            if stage >= 2:
+                # pin grads to the dp-sharded layout: the dp all-reduce
+                # lowers to reduce-scatter and grad memory stays sharded
+                # (ZeRO-2)
+                grads = jax.lax.with_sharding_constraint(
+                    grads, grad_shardings)
+            updates, new_opt = optimizer.update(
+                grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            return TrainState(new_params, new_opt, state.step + 1), loss
 
     jit_step = jax.jit(
         step,
@@ -416,10 +578,18 @@ def run_train(
 
             validate_pipeline(model_cfg, plan.pp, bs // grad_accum,
                               plan.num_microbatches)
-    from dlbb_tpu.train.optim import build_optimizer, resolve_names
+    from dlbb_tpu.train.optim import (
+        build_optimizer,
+        compression_accum_dtype,
+        moments_dtype,
+        resolve_grad_compression,
+        resolve_names,
+    )
 
     optimizer = build_optimizer(train_cfg)
     opt_name, sched_name = resolve_names(train_cfg)
+    grad_compression = resolve_grad_compression(train_cfg)
+    comp_accum = compression_accum_dtype(train_cfg)
 
     pipeline_schedule = str(train_cfg.get("pipeline_schedule", "gpipe"))
     params = init_params_sharded(
@@ -429,6 +599,10 @@ def run_train(
         model_cfg, mesh, optimizer, params, zero_stage=stage,
         num_microbatches=num_microbatches, moe_aux_weight=moe_aux_weight,
         grad_accum=grad_accum, pipeline_schedule=pipeline_schedule,
+        grad_compression=grad_compression, compression_accum=comp_accum,
+        # the residual follows the moments-storage convention: bf16/fp16
+        # moments => bf16/fp16 residual (memory-reduced Adam)
+        residual_dtype=moments_dtype(train_cfg),
     )
     # make_train_step may have resharded params into fresh buffers (ZeRO-3);
     # at 13B scale the caller's copy is tens of GB of dead weight on the
@@ -598,8 +772,6 @@ def run_train(
     )
     mean_step = float(np.mean(step_times))
 
-    from dlbb_tpu.train.optim import moments_dtype as _moments_dtype
-
     result = {
         "experiment": config.get("experiment", {}),
         "backend": "xla_tpu",
@@ -607,6 +779,12 @@ def run_train(
         "mode": MODE_NAMES[stage],
         "zero_stage": stage,
         "resumed_from_step": resumed_from,
+        # quantised gradient reduction (docs/compression.md): "none" =
+        # the GSPMD all-reduce path; int8/fp8 = the error-feedback ring
+        "grad_compression": grad_compression,
+        "compression_accum_dtype": (
+            comp_accum if grad_compression != "none" else None
+        ),
         # graceful-preemption marker: True when SIGTERM cut the loop short
         # after >=1 timed sample (stats below cover the completed steps)
         "preempted": preempted_at is not None,
@@ -614,7 +792,7 @@ def run_train(
         "mesh": plan.mesh_dict(),
         "learning_rate": lr,
         "optimizer": opt_name,
-        "moments_dtype": _moments_dtype(train_cfg),
+        "moments_dtype": moments_dtype(train_cfg),
         "schedule": sched_name,
         "gradient_accumulation": grad_accum,
         "pipeline_schedule": pipeline_schedule if plan.pp > 1 else None,
@@ -667,12 +845,18 @@ def run_train_from_config(
     output_dir: Optional[str] = None,
     devices: Optional[Sequence] = None,
     tp_overlap: Optional[str] = None,
+    grad_compression: Optional[str] = None,
 ) -> dict[str, Any]:
     """``tp_overlap`` overrides the config's ``model.tp_overlap`` (the
-    ``--tp-overlap`` CLI flag), mirroring ``run_e2e_from_config``."""
+    ``--tp-overlap`` CLI flag), mirroring ``run_e2e_from_config``;
+    ``grad_compression`` overrides ``training.grad_compression`` the same
+    way (the ``--grad-compression`` flag)."""
     config = load_config(config_path)
     if tp_overlap is not None:
         config.setdefault("model", {})["tp_overlap"] = tp_overlap
+    if grad_compression is not None:
+        config.setdefault("training", {})["grad_compression"] = \
+            grad_compression
     out = output_dir or config.get("experiment", {}).get("output_dir")
     return run_train(config, zero1=zero1, zero_stage=zero_stage,
                      devices=devices, output_dir=out)

@@ -16,7 +16,7 @@ that simulates the 8-device mesh (``scripts/publish_baselines.py``).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -27,6 +27,68 @@ SCHEDULES = ("constant", "cosine", "warmup_cosine")
 DEFAULT_OPTIMIZER = "adam"
 DEFAULT_SCHEDULE = "constant"
 DEFAULT_LR = 1e-3
+
+# training.grad_compression: quantised gradient reduction with error
+# feedback (docs/compression.md); "none" is the uncompressed GSPMD path
+GRAD_COMPRESSIONS = ("none", "int8", "fp8")
+COMPRESSION_ACCUM_DTYPES = ("float32", "bfloat16")
+
+
+class GradCompressionState(NamedTuple):
+    """Error-feedback residual for compressed gradient reduction.
+
+    ``residual`` is ``[dp, total_params]`` — each data-parallel rank's
+    local quantisation error (``comm/compression.py::quantization_error``),
+    flattened over the whole parameter pytree.  It lives as an
+    optimizer-state leaf so it is sharded like the gradients
+    (``P("dp")`` — one row per rank, never replicated), checkpointed with
+    the rest of the optimizer state, and stored in ``moments_dtype`` when
+    one is configured (the memory-reduced-Adam convention)."""
+
+    residual: Any
+
+
+def resolve_grad_compression(train_cfg: dict[str, Any]) -> str:
+    """The configured ``training.grad_compression`` mode, validated."""
+    mode = str(train_cfg.get("grad_compression", "none"))
+    if mode not in GRAD_COMPRESSIONS:
+        raise ValueError(
+            f"unknown training.grad_compression {mode!r}; known: "
+            f"{GRAD_COMPRESSIONS}"
+        )
+    return mode
+
+
+def compression_accum_dtype(train_cfg: dict[str, Any]) -> str:
+    """The configured ``training.compression_accum_dtype`` (the ring's
+    accumulation precision; fp32 default, bf16 the reduced variant)."""
+    dt = str(train_cfg.get("compression_accum_dtype", "float32"))
+    if dt not in COMPRESSION_ACCUM_DTYPES:
+        raise ValueError(
+            f"unknown training.compression_accum_dtype {dt!r} "
+            f"(expected one of {COMPRESSION_ACCUM_DTYPES})"
+        )
+    return dt
+
+
+def init_error_feedback(params: Any, dp_size: int, dtype=jnp.float32,
+                        sharding: Any = None) -> GradCompressionState:
+    """Zero residual for the whole (flattened) parameter pytree — one
+    row per data-parallel rank.  With ``sharding`` (the residual's
+    ``P("dp")`` NamedSharding) the zeros are created DIRECTLY sharded via
+    a jitted out-sharding: materialising the replicated ``[dp, total]``
+    buffer first would transiently cost dp x the flat parameter bytes on
+    one device — exactly the spike that matters at the 13B scale the
+    train loop otherwise avoids."""
+    total = int(sum(p.size for p in jax.tree.leaves(params)))
+    shape, dt = (dp_size, total), jnp.dtype(dtype)
+    if sharding is not None:
+        zeros = jax.jit(
+            lambda: jnp.zeros(shape, dt), out_shardings=sharding
+        )()
+    else:
+        zeros = jnp.zeros(shape, dt)
+    return GradCompressionState(residual=zeros)
 
 
 def resolve_names(train_cfg: dict[str, Any]) -> tuple[str, str]:
@@ -86,16 +148,25 @@ def cast_moments(
     """Store ``inner``'s floating optimizer-state leaves in ``dtype``;
     the update math still runs in fp32 (state is upcast around
     ``inner.update``).  Generic over the wrapped transformation: every
-    floating-point state leaf (Adam mu/nu, SGD momentum, adafactor
-    statistics) is cast; integer leaves (step counts) pass through."""
+    *wide* floating-point state leaf (Adam mu/nu, SGD momentum, adafactor
+    statistics — fp16/bf16/fp32/fp64) is cast; integer leaves (step
+    counts) and byte-wide quantised bookkeeping (int8 / fp8 residual
+    caches from compressed-gradient state) pass through untouched —
+    float-casting a quantised payload would corrupt it, and round-tripping
+    it through fp32 in ``update`` would silently widen its storage."""
     dtype = jnp.dtype(dtype)
+
+    def _castable(x) -> bool:
+        if not hasattr(x, "dtype"):
+            return False  # python scalars / exotic leaves: leave alone
+        dt = jnp.dtype(x.dtype)
+        # "wide float" = >= 2-byte IEEE float: excludes integers, bools,
+        # AND the 1-byte fp8 wire dtypes used as quantised bookkeeping
+        return jnp.issubdtype(dt, jnp.floating) and dt.itemsize >= 2
 
     def _cast(tree, to):
         return jax.tree.map(
-            lambda x: x.astype(to)
-            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
-            else x,
-            tree,
+            lambda x: x.astype(to) if _castable(x) else x, tree,
         )
 
     def init(params):

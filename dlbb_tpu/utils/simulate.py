@@ -7,15 +7,41 @@ platform back to CPU via *config* — the TPU plugin's sitecustomize overrides
 the ``JAX_PLATFORMS`` env var at import time, so the env alone is ignored.
 
 Shared by the CLI (``--simulate N``) and ``tests/conftest.py``.
+
+This module is also the bookkeeper for WHY the process is on CPU: rounds
+4–5 silently lost the chip (ROADMAP item 5), so a degraded fallback — the
+backend probe timing out and ``bench.py`` standing up the simulated mesh
+instead — must become a first-class, journaled event, not a stderr line.
+:func:`topology_record` is the one place that classifies the backend
+(requested simulation vs silent CPU fallback) and every sweep writes it
+into ``sweep_manifest.json`` and the sweep journal
+(``dlbb_tpu/bench/runner.py``).
 """
 
 from __future__ import annotations
 
 import os
 import re
+from typing import Any, Optional
+
+# Set by force_cpu_simulation: the CPU backend was explicitly requested
+# (CLI --simulate, tests, a bench script) rather than silently fallen
+# back to.
+_SIMULATION_FORCED = False
+# The recorded reason when the simulation IS a degraded fallback (the
+# bench.py device probe found the accelerator unreachable).
+_DEGRADED_REASON: Optional[str] = None
 
 
-def force_cpu_simulation(num_devices: int) -> None:
+def force_cpu_simulation(num_devices: int,
+                         degraded_reason: Optional[str] = None) -> None:
+    """Stand up an ``num_devices``-device CPU-simulated mesh.
+
+    ``degraded_reason`` marks this simulation as a *fallback* (the
+    accelerator backend was wanted but unreachable); it flows into every
+    subsequent :func:`topology_record` so sweeps journal the degradation
+    instead of logging it."""
+    global _SIMULATION_FORCED, _DEGRADED_REASON
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" in flags:
         flags = re.sub(
@@ -27,7 +53,57 @@ def force_cpu_simulation(num_devices: int) -> None:
         flags = f"{flags} --xla_force_host_platform_device_count={num_devices}"
     os.environ["XLA_FLAGS"] = flags.strip()
     os.environ["JAX_PLATFORMS"] = "cpu"
+    _SIMULATION_FORCED = True
+    if degraded_reason is not None:
+        _DEGRADED_REASON = degraded_reason
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def simulation_forced() -> bool:
+    """Whether this process explicitly requested the CPU-simulated mesh."""
+    return _SIMULATION_FORCED
+
+
+def degraded_reason() -> Optional[str]:
+    """The recorded degradation reason, or None when the backend is the
+    one the process asked for."""
+    return _DEGRADED_REASON
+
+
+def topology_record() -> dict[str, Any]:
+    """The topology fingerprint every sweep artifact set carries
+    (``sweep_manifest.json`` ``topology`` key + a ``topology`` journal
+    event): which platform actually backs the mesh, how many devices and
+    processes, and whether that is a DEGRADED state — either an explicit
+    probe-fallback (:func:`force_cpu_simulation` with a reason) or a
+    silent landing on CPU that nobody requested (the exact failure mode
+    of rounds 4–5, where the tunnel died and benches fell back without a
+    durable record)."""
+    import jax
+
+    platform = jax.default_backend()
+    silent_cpu = (
+        platform == "cpu"
+        and not _SIMULATION_FORCED
+        and os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu"
+    )
+    degraded = _DEGRADED_REASON is not None or silent_cpu
+    rec: dict[str, Any] = {
+        "platform": platform,
+        "num_devices": len(jax.devices()),
+        "process_count": jax.process_count(),
+        "simulated": platform == "cpu",
+        "simulation_forced": _SIMULATION_FORCED,
+        "degraded": bool(degraded),
+    }
+    if _DEGRADED_REASON is not None:
+        rec["degraded_reason"] = _DEGRADED_REASON
+    elif silent_cpu:
+        rec["degraded_reason"] = (
+            "process landed on the CPU backend without simulation being "
+            "requested (accelerator plugin unavailable?)"
+        )
+    return rec

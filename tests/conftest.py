@@ -53,6 +53,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "compression_smoke: quantised-collective smoke — allreduce_q "
+        "variant mini-sweep + one compressed train step (tier-1; also "
+        "invoked standalone by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )

@@ -172,6 +172,11 @@ def test_estimate_global_bytes_pinned_per_op():
         # fused matmul_rs a full per-device partial product (P)
         "ag_matmul": p + p + p * p,
         "matmul_rs": p + p + p,
+        # compressed micro-ops (docs/compression.md): same declared buffer
+        # kinds as their uncompressed counterparts — the quantised copies
+        # are byte-wide transients well inside the in+out envelope
+        "allreduce_q": p + p,
+        "reducescatter_q": p * p + p,
     }
     assert sorted(expected_mults) == sorted(OPERATIONS)  # full coverage
     s = Sweep1D(dtype="float32")
@@ -318,8 +323,9 @@ def test_stats_1d_granularity_marker(tmp_path):
         tmp_path / "s" / "benchmark_statistics.csv"
     ).read_text().splitlines()
     # extension columns: granularity marker + dtype (the corpus carries
-    # the north-star curve in both bf16 and fp32)
-    assert csv_lines[0].endswith("timing_granularity,dtype")
+    # the north-star curve in both bf16 and fp32) + the analytic wire
+    # volume (docs/compression.md)
+    assert csv_lines[0].endswith("timing_granularity,dtype,bytes_on_wire")
     assert any("chunked(5)" in line for line in csv_lines[1:])
     assert any("per_iteration" in line for line in csv_lines[1:])
     # the full caveat text lands in the per-file stats JSON

@@ -146,6 +146,80 @@ def test_bf16_moments_adam_matches_fp32(devices):
     assert float_dtypes2 == {jnp.dtype(jnp.bfloat16)}
 
 
+def test_fp16_moments_roundtrip(devices):
+    """float16 moments_dtype round-trip (only bf16 was exercised before).
+
+    SGD momentum state lives at gradient scale — comfortably inside
+    fp16's exponent range — so its fp16-moments trajectory must track
+    fp32 within rounding.  Adam is the documented exception: early-step
+    ``nu`` values ((1-beta2) * grad^2 ~ 1e-7) sit BELOW fp16's 6e-5
+    min-normal, so fp16 Adam moments degrade by construction (bf16, with
+    fp32's exponent range, is the memory-reduced-Adam dtype); the pin
+    here is that it still runs finite and stores fp16, not that it
+    matches."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlbb_tpu.train.optim import cast_moments
+
+    sgd = dict(optimizer="sgd", momentum=0.9, learning_rate=0.05)
+    r32 = run_train(_config(**sgd), verbose=False)
+    r16 = run_train(_config(**sgd, moments_dtype="float16"), verbose=False)
+    assert r16["moments_dtype"] == "float16"
+    np.testing.assert_allclose(r16["losses"], r32["losses"],
+                               rtol=2e-2, atol=1e-3)
+
+    r16a = run_train(_config(optimizer="adam", moments_dtype="float16"),
+                     verbose=False)
+    assert all(np.isfinite(r16a["losses"]))
+
+    opt = cast_moments(optax.adam(1e-3), jnp.float16)
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    state = opt.init(params)
+    grads = {"w": jnp.full((4, 4), 0.5, jnp.float32)}
+    updates, state2 = opt.update(grads, state, params)
+    assert updates["w"].dtype == jnp.float32
+    float_dtypes = {
+        x.dtype for x in jax.tree.leaves(state2)
+        if jnp.issubdtype(x.dtype, jnp.floating)
+    }
+    assert float_dtypes == {jnp.dtype(jnp.float16)}
+
+
+def test_cast_moments_skips_quantized_bookkeeping():
+    """Integer and byte-wide quantised leaves (int8 counters, fp8 residual
+    caches from compressed-gradient state) must pass through cast_moments
+    untouched — float-casting a quantised payload corrupts it, and the
+    fp32 upcast inside update must not widen its storage."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dlbb_tpu.train.optim import cast_moments
+
+    book = {"q": jnp.arange(-4, 4, dtype=jnp.int8),
+            "f8": jnp.asarray([0.5, -0.25], jnp.float8_e4m3fn),
+            "count": jnp.zeros((), jnp.int32),
+            "mu": jnp.zeros((4,), jnp.float32)}
+
+    inner = optax.GradientTransformation(
+        init=lambda params: jax.tree.map(jnp.copy, book),
+        update=lambda u, s, params=None: (u, s),
+    )
+    opt = cast_moments(inner, jnp.bfloat16)
+    state = opt.init({"w": jnp.ones((4,), jnp.float32)})
+    assert state["q"].dtype == jnp.int8
+    assert state["f8"].dtype == jnp.float8_e4m3fn
+    assert state["count"].dtype == jnp.int32
+    assert state["mu"].dtype == jnp.bfloat16  # the real moment IS cast
+    _, state2 = opt.update({"w": jnp.zeros(4)}, state)
+    assert state2["q"].dtype == jnp.int8
+    assert state2["f8"].dtype == jnp.float8_e4m3fn
+    np.testing.assert_array_equal(np.asarray(state2["q"]),
+                                  np.arange(-4, 4))
+
+
 def test_moments_dtype_rejected_unknown():
     with pytest.raises(ValueError, match="moments_dtype"):
         build_optimizer({"optimizer": "adam", "moments_dtype": "int8"})
