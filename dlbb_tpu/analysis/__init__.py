@@ -1,29 +1,57 @@
 """comm-lint: static verification that benchmarks match their parallelism
 plan.
 
-Two passes (see docs/analysis.md for the rule catalogue):
+Three passes (see docs/analysis.md + docs/schedule_audit.md for the rule
+catalogues):
 
-- ``hlo``  — lower + compile every registered benchmark computation on the
-  current (usually ``--simulate N`` CPU) mesh and audit the post-SPMD HLO
-  for unexpected / missing / oversized collectives and missing buffer
+- ``hlo``      — lower + compile every registered benchmark computation on
+  the current (usually ``--simulate N`` CPU) mesh and audit the post-SPMD
+  HLO for unexpected / missing / oversized collectives and missing buffer
   donation (``hlo_audit``).
-- ``lint`` — AST rules over ``dlbb_tpu/`` and ``scripts/`` for host syncs
-  in timed regions, undonated train-step jits, jit-in-loop recompile
-  hazards, and unsorted set iteration (``source_lint``).
+- ``schedule`` — the α–β schedule auditor over the same lowered modules:
+  overlap verification (every ring hop must have a straddling matmul),
+  critical-path estimate, divergent-branch deadlock check
+  (``schedule_audit``).
+- ``lint``     — AST rules over ``dlbb_tpu/`` and ``scripts/`` for host
+  syncs and wall-clock reads in timed regions, undonated train-step jits,
+  jit-in-loop recompile hazards, unsorted set iteration, and non-atomic
+  artifact writes (``source_lint``).
 
-CLI: ``python -m dlbb_tpu.cli analyze [hlo|lint|all] --simulate 8``.
+Plus the regression-baseline gate over the schedule pass:
+
+- ``snapshot`` — write per-target baselines to ``stats/analysis/baselines``
+  (refuses while the audit itself has error findings).
+- ``diff``     — compare a fresh audit against the committed baselines and
+  fail on unexplained growth (>10 % critical path / wire, new collective
+  kind).
+
+CLI: ``python -m dlbb_tpu.cli analyze [hlo|lint|schedule|all|snapshot|diff]
+--simulate 8``.  Exit codes are a pinned contract (``findings.EXIT_*``):
+0 = clean, 1 = findings, 2 = the analyzer crashed.
 """
 
 from __future__ import annotations
 
+from pathlib import Path
 from typing import Optional
 
 from dlbb_tpu.analysis.findings import (  # noqa: F401
+    EXIT_CLEAN,
+    EXIT_CRASH,
+    EXIT_FINDINGS,
     SEVERITY_ERROR,
     AnalysisReport,
     Finding,
 )
 from dlbb_tpu.analysis.source_lint import run_source_lint  # noqa: F401
+
+_HLO_PASSES = {
+    "hlo": ("hlo",),
+    "schedule": ("schedule",),
+    "all": ("hlo", "schedule"),
+    "snapshot": ("hlo", "schedule"),
+    "diff": ("hlo", "schedule"),
+}
 
 
 def run_analysis(
@@ -32,17 +60,46 @@ def run_analysis(
     json_path: Optional[str] = None,
     verbose: bool = True,
     strict_warnings: bool = False,
+    baselines: Optional[str] = None,
+    tier: Optional[str] = None,
 ) -> int:
     """Run the requested passes; print the human summary; optionally write
-    the JSON report.  Returns the process exit code (0 = clean)."""
+    the JSON report.  Returns the pinned exit code: 0 clean / 1 findings /
+    2 crash (an exception anywhere in the analyzer must surface as 2, not
+    as a stack trace with an arbitrary code — the CI gates compose on
+    this)."""
+    try:
+        return _run_analysis(
+            which=which, root=root, json_path=json_path, verbose=verbose,
+            strict_warnings=strict_warnings, baselines=baselines, tier=tier,
+        )
+    except Exception:  # noqa: BLE001 — the exit-code contract
+        import traceback
+
+        traceback.print_exc()
+        return EXIT_CRASH
+
+
+def _run_analysis(
+    which: str,
+    root: Optional[str],
+    json_path: Optional[str],
+    verbose: bool,
+    strict_warnings: bool,
+    baselines: Optional[str],
+    tier: Optional[str],
+) -> int:
+    from dlbb_tpu.analysis.schedule_audit import DEFAULT_BASELINE_DIR
+
     report = AnalysisReport()
     if which in ("lint", "all"):
         report.extend(run_source_lint(root=root, verbose=False))
-    if which in ("hlo", "all"):
+    hlo_passes = _HLO_PASSES.get(which)
+    if hlo_passes:
         # imported lazily: the lint pass must work without touching jax
         from dlbb_tpu.analysis.hlo_audit import run_hlo_audit
 
-        hlo = run_hlo_audit(verbose=verbose)
+        hlo = run_hlo_audit(verbose=verbose, passes=hlo_passes, tier=tier)
         if not hlo.targets_audited:
             # every target skipped for lack of devices — a CI gate wired to
             # our exit code must not read that as a clean audit
@@ -56,6 +113,35 @@ def run_analysis(
                 ),
             ))
         report.extend(hlo)
+
+    base_dir = Path(baselines) if baselines else DEFAULT_BASELINE_DIR
+    if which == "snapshot":
+        from dlbb_tpu.analysis.schedule_audit import snapshot_baselines
+
+        if report.errors:
+            # refuse to freeze a dirty tree: a snapshot of a failing audit
+            # would launder the failure into the committed gate
+            print("[analyze] snapshot refused: the audit has error "
+                  "findings — fix them first")
+        else:
+            written = snapshot_baselines(
+                report.schedule, base_dir,
+                skipped_targets=tuple(
+                    s["target"] for s in report.skipped_targets
+                ),
+            )
+            if verbose:
+                print(f"[analyze] {len(written)} baseline snapshot(s) "
+                      f"written to {base_dir}")
+    elif which == "diff":
+        from dlbb_tpu.analysis.schedule_audit import diff_baselines
+
+        report.findings.extend(diff_baselines(
+            report.schedule, base_dir,
+            skipped_targets=tuple(
+                s["target"] for s in report.skipped_targets
+            ),
+        ))
     if verbose:
         print(report.render_summary())
     if json_path:

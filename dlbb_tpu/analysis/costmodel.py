@@ -1,0 +1,118 @@
+"""Versioned α–β / peak-FLOPs cost-model table.
+
+The static schedule auditor (``schedule_audit.py``) prices every HLO
+instruction with the classic Hockney α–β model: a collective moving ``w``
+analytic wire bytes on link tier ``t`` costs ``α(t) + w / β(t)``
+microseconds, a dense-compute instruction doing ``f`` FLOPs costs
+``f / peak(t)``.  The table is deliberately small and **versioned**: the
+numbers are seeds (they make the *relative* structure of a schedule —
+what serialises with what — falsifiable, not the absolute walls), and
+ROADMAP item 2 replaces them with coefficients fitted from sweep
+artifacts.  Any change to the numbers must bump ``COST_MODEL_VERSION``:
+committed schedule baselines (``stats/analysis/baselines/``) record the
+version they were priced with, and ``analyze diff`` refuses to compare
+across versions (re-snapshot instead).
+
+Tier provenance:
+
+- ``cpu-sim`` — the ``--simulate N`` host-process mesh.  "Links" are
+  shared-memory copies (~10 GB/s sustained, ~1 µs wakeup); peak compute
+  is a conservative single-core ~50 GFLOP/s.  This is the tier every CI
+  baseline is priced with.
+- ``tpu-v5lite`` — TPU v5e: ICI ~45 GB/s/direction per link, ~1 µs hop
+  latency; bf16 peak 197 TFLOP/s (the round-1..3 chip rows measured
+  ~175 TFLOP/s sustained on the 1B forward, consistent with this peak).
+- ``tpu-v5lite-dcn`` — inter-slice data-center network, ~100 Gb/s and
+  ~10 µs latency: the tier a multi-host pod's cross-slice collectives
+  are priced with once the backend-matrix refactor (ROADMAP item 5)
+  lands per-tier topology fingerprints.
+
+This module must stay importable WITHOUT jax — the schedule auditor's
+unit tests and the sweep manifest writer run backend-free.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+COST_MODEL_VERSION = "cm1"
+
+
+@dataclass(frozen=True)
+class CostTier:
+    """One link + compute tier of the α–β table.
+
+    alpha_us:           per-collective fixed latency (hop setup) in µs.
+    beta_bytes_per_us:  sustained link bandwidth (bytes per µs == MB/s
+                        divided by ~1.05; 1 GB/s == 1000 bytes/µs).
+    peak_flops_per_us:  dense-compute peak (FLOPs per µs; 1 TFLOP/s ==
+                        1e6 FLOPs/µs).
+    """
+
+    name: str
+    alpha_us: float
+    beta_bytes_per_us: float
+    peak_flops_per_us: float
+    description: str = ""
+
+
+# version -> tier name -> CostTier.  Append-only: old versions stay so a
+# baseline priced with them remains interpretable.
+COST_MODELS: dict[str, dict[str, CostTier]] = {
+    "cm1": {
+        "cpu-sim": CostTier(
+            name="cpu-sim",
+            alpha_us=1.0,
+            beta_bytes_per_us=10_000.0,      # ~10 GB/s shared-memory copy
+            peak_flops_per_us=50_000.0,      # ~50 GFLOP/s single core
+            description="--simulate N host-process mesh (CI baseline tier)",
+        ),
+        "tpu-v5lite": CostTier(
+            name="tpu-v5lite",
+            alpha_us=1.0,
+            beta_bytes_per_us=45_000.0,      # ~45 GB/s/dir ICI link
+            peak_flops_per_us=197_000_000.0,  # 197 TFLOP/s bf16 peak
+            description="TPU v5e single slice, ICI ring",
+        ),
+        "tpu-v5lite-dcn": CostTier(
+            name="tpu-v5lite-dcn",
+            alpha_us=10.0,
+            beta_bytes_per_us=12_500.0,      # ~100 Gb/s DCN
+            peak_flops_per_us=197_000_000.0,
+            description="TPU v5e cross-slice data-center network",
+        ),
+    },
+}
+
+DEFAULT_TIER = "cpu-sim"
+
+
+def get_tier(name: Optional[str] = None,
+             version: str = COST_MODEL_VERSION) -> CostTier:
+    """Look up a tier in one model version; raises KeyError with the
+    known names on a typo so the CLI error is actionable."""
+    table = COST_MODELS.get(version)
+    if table is None:
+        raise KeyError(
+            f"unknown cost-model version {version!r}; "
+            f"known: {sorted(COST_MODELS)}"
+        )
+    tier = table.get(name or DEFAULT_TIER)
+    if tier is None:
+        raise KeyError(
+            f"unknown cost-model tier {name!r}; known: {sorted(table)}"
+        )
+    return tier
+
+
+def collective_cost_us(wire_bytes: int, tier: CostTier) -> float:
+    """α + bytes/β: the Hockney cost of moving ``wire_bytes`` analytic
+    wire bytes (``expectations.wire_bytes`` — per-device, the ring
+    algorithm's multiplier already factored in) over one tier."""
+    return tier.alpha_us + wire_bytes / tier.beta_bytes_per_us
+
+
+def compute_cost_us(flops: int, tier: CostTier) -> float:
+    """FLOPs / peak: dense-compute time at the tier's peak throughput."""
+    return flops / tier.peak_flops_per_us

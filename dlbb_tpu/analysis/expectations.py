@@ -223,6 +223,12 @@ class TargetExpectation:
     expect_donation:    the computation must donate at least one input
                         buffer (train-step convention — without it XLA
                         keeps input and output state resident).
+    expect_overlap:     the target claims its collectives are hidden
+                        behind compute (the ring-decomposed collective-
+                        matmul schedules): the schedule auditor emits a
+                        ``serialized-collective`` error for every ring
+                        hop with no straddling matmul
+                        (``schedule_audit.analyze_schedule``).
     """
 
     allowed: set[str] = field(default_factory=set)
@@ -231,6 +237,7 @@ class TargetExpectation:
     max_bytes_per_instr: Optional[int] = None
     max_total_wire_bytes: Optional[int] = None
     expect_donation: bool = False
+    expect_overlap: bool = False
 
 
 def op_expectation(op_name: str, payload_bytes_per_rank: int,
@@ -378,4 +385,5 @@ def overlap_op_expectation(p: int, chunk_bytes: int,
         required_any={"collective-permute"},
         min_required=p - 1,
         max_bytes_per_instr=int(chunk_bytes * slack),
+        expect_overlap=True,
     )

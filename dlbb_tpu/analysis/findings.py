@@ -16,6 +16,16 @@ from typing import Any, Optional
 SEVERITY_ERROR = "error"
 SEVERITY_WARNING = "warning"
 
+# the `analyze` CLI exit-code contract (docs/schedule_audit.md; pinned by
+# tests/test_schedule_audit.py so the CI diff gate can compose with the
+# chaos and compression smoke stages): 0 = clean, 1 = findings (errors,
+# or warnings under --strict-warnings), 2 = the analyzer itself crashed
+# (or unusable arguments).  Anything mapping findings to a different
+# code is a bug.
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_CRASH = 2
+
 
 @dataclass
 class Finding:
@@ -67,6 +77,9 @@ class AnalysisReport:
     targets_audited: list[str] = field(default_factory=list)
     files_linted: int = 0
     skipped_targets: list[dict[str, str]] = field(default_factory=list)
+    # target name -> schedule meta (critical_path_us / overlap_efficiency
+    # / inventory; schedule_audit.analyze_schedule) — the baseline payload
+    schedule: dict[str, dict] = field(default_factory=dict)
 
     def extend(self, other: "AnalysisReport") -> None:
         self.findings.extend(other.findings)
@@ -74,6 +87,7 @@ class AnalysisReport:
         self.targets_audited.extend(other.targets_audited)
         self.files_linted += other.files_linted
         self.skipped_targets.extend(other.skipped_targets)
+        self.schedule.update(other.schedule)
 
     @property
     def errors(self) -> list[Finding]:
@@ -85,14 +99,15 @@ class AnalysisReport:
 
     def exit_code(self, strict_warnings: bool = False) -> int:
         if self.errors:
-            return 1
+            return EXIT_FINDINGS
         if strict_warnings and self.warnings:
-            return 1
-        return 0
+            return EXIT_FINDINGS
+        return EXIT_CLEAN
 
     def to_dict(self) -> dict[str, Any]:
         return {
             "findings": [f.to_dict() for f in self.findings],
+            "schedule": self.schedule,
             "summary": {
                 "errors": len(self.errors),
                 "warnings": len(self.warnings),
@@ -119,6 +134,8 @@ class AnalysisReport:
             f"{len(self.warnings)} warning(s), {self.suppressed} suppressed; "
             f"{len(self.targets_audited)} HLO target(s) audited, "
             f"{self.files_linted} file(s) linted"
+            + (f", {len(self.schedule)} schedule report(s)"
+               if self.schedule else "")
             + (f", {len(self.skipped_targets)} target(s) skipped"
                if self.skipped_targets else "")
         )

@@ -55,20 +55,42 @@ class AuditTarget:
     min_devices: int = 1
 
 
-def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
+def audit_target(
+    target: AuditTarget,
+    passes: Sequence[str] = ("hlo",),
+    tier: Optional[str] = None,
+) -> tuple[list[Finding], dict]:
     """Lower, compile, parse, and check one target.  Returns the findings
-    plus a meta dict (instruction inventory) for the JSON report."""
+    plus a meta dict (instruction inventory, and — when the ``schedule``
+    pass is requested — the α–β schedule report) for the JSON report.
+    One lowering serves both passes: ``analyze all`` does not compile the
+    30-target surface twice."""
     import jax
+
+    from dlbb_tpu.analysis.hlo_parse import parse_module
 
     fn, args = target.build()
     jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
     lowered = jitted.lower(*args)
     compiled = lowered.compile()
     compiled_text = compiled.as_text()
-    instrs = parse_collectives(compiled_text)
+    module = parse_module(compiled_text)
     exp = target.expectation
 
     findings: list[Finding] = []
+    meta: dict = {}
+    if "schedule" in passes:
+        from dlbb_tpu.analysis.schedule_audit import analyze_schedule
+
+        sched_findings, sched_meta = analyze_schedule(
+            module, exp, target.name, tier=tier,
+        )
+        findings.extend(sched_findings)
+        meta["schedule"] = sched_meta
+    if "hlo" not in passes:
+        return findings, meta
+
+    instrs = parse_collectives(module)
     for instr in instrs:
         base = _instr_details(instr, exp)
         if instr.kind not in exp.allowed:
@@ -103,28 +125,35 @@ def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
                 details=base,
             ))
     if exp.required_any:
-        hits = [i for i in instrs if i.kind in exp.required_any]
-        if len(hits) < exp.min_required:
+        # execution-weighted: a collective inside a scanned layer body
+        # counts once per trip, not once per static instruction (the
+        # while-body undercount fix, pinned by test_schedule_audit)
+        hits = sum(
+            i.execution_count for i in instrs if i.kind in exp.required_any
+        )
+        if hits < exp.min_required:
             findings.append(Finding(
                 pass_name="hlo",
                 rule="missing-collective",
                 severity=SEVERITY_ERROR,
                 target=target.name,
                 message=(
-                    f"expected >= {exp.min_required} instruction(s) of "
-                    f"{sorted(exp.required_any)}, found {len(hits)} — the "
+                    f"expected >= {exp.min_required} execution(s) of "
+                    f"{sorted(exp.required_any)}, found {hits} — the "
                     "benchmark does not perform the collective it claims "
                     "(XLA may have elided or replaced it)"
                 ),
                 details={
                     "expected_kinds": sorted(exp.required_any),
                     "expected_min_count": exp.min_required,
-                    "found_count": len(hits),
+                    "found_count": hits,
                     "present": [i.to_dict() for i in instrs],
                 },
             ))
     total_wire = sum(
-        wire_bytes(i.kind, i.result_bytes, i.group_size) for i in instrs
+        wire_bytes(i.kind, i.result_bytes, i.group_size)
+        * i.execution_count
+        for i in instrs
     )
     if (exp.max_total_wire_bytes is not None
             and total_wire > exp.max_total_wire_bytes):
@@ -145,6 +174,7 @@ def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
                 "max_total_wire_bytes": exp.max_total_wire_bytes,
                 "per_instr_wire_bytes": [
                     {"kind": i.kind,
+                     "execution_count": i.execution_count,
                      "wire_bytes": wire_bytes(
                          i.kind, i.result_bytes, i.group_size)}
                     for i in instrs
@@ -166,11 +196,11 @@ def audit_target(target: AuditTarget) -> tuple[list[Finding], dict]:
             ),
             details={"expected": "donate_argnums on the step jit"},
         ))
-    meta = {
+    meta.update({
         "collectives": [i.to_dict() for i in instrs],
-        "num_collectives": len(instrs),
+        "num_collectives": sum(i.execution_count for i in instrs),
         "total_wire_bytes": total_wire,
-    }
+    })
     return findings, meta
 
 
@@ -463,6 +493,9 @@ def _tp_overlap_forward_target(schedule: str, dp: int = 2,
             # 4 ring matmuls per scanned layer body, (tp-1) hops each
             min_required=4 * (tp - 1),
             max_bytes_per_instr=int(act_bytes * 1.25),
+            # every ring hop must be hidden behind a partial matmul —
+            # the schedule auditor's serialized-collective gate
+            expect_overlap=True,
         ),
         min_devices=dp * tp,
     )
@@ -524,6 +557,7 @@ def _tp_overlap_train_target(schedule: str, dp: int = 2,
             min_required=4 * (tp - 1),
             max_bytes_per_instr=int(params_bytes * 1.25),
             expect_donation=True,
+            expect_overlap=True,
         ),
         min_devices=dp * tp,
     )
@@ -682,15 +716,38 @@ def default_targets() -> list[AuditTarget]:
     return targets
 
 
+def default_tier() -> str:
+    """The cost-model tier matching the current backend: the CPU-simulated
+    mesh prices at ``cpu-sim`` (the committed-baseline tier); a real TPU
+    at ``tpu-v5lite``."""
+    import jax
+
+    return "cpu-sim" if jax.default_backend() == "cpu" else "tpu-v5lite"
+
+
 def run_hlo_audit(
     targets: Optional[Sequence[AuditTarget]] = None,
     verbose: bool = False,
+    passes: Sequence[str] = ("hlo",),
+    tier: Optional[str] = None,
 ) -> AnalysisReport:
     """Audit ``targets`` (default: the standing registry) on the current
-    backend.  Targets needing more devices than available are recorded as
-    skipped, not failed — the CLI's ``--simulate N`` controls the mesh."""
+    backend.  ``passes`` selects the byte auditor (``"hlo"``), the α–β
+    schedule auditor (``"schedule"``), or both — one lowering per target
+    either way.  Targets needing more devices than available are recorded
+    as skipped, not failed — the CLI's ``--simulate N`` controls the
+    mesh."""
     import jax
 
+    if "schedule" in passes:
+        if tier is None:
+            tier = default_tier()
+        # validate once, before any lowering: a mistyped --tier must be
+        # EXIT_CRASH (unusable arguments), not 30 repeated audit-crash
+        # findings after minutes of wasted compiles
+        from dlbb_tpu.analysis.costmodel import get_tier
+
+        get_tier(tier)
     report = AnalysisReport()
     n_devices = len(jax.devices())
     for target in targets if targets is not None else default_targets():
@@ -702,7 +759,7 @@ def run_hlo_audit(
             })
             continue
         try:
-            findings, _meta = audit_target(target)
+            findings, _meta = audit_target(target, passes=passes, tier=tier)
         except Exception as e:  # noqa: BLE001 — one target's lowering
             # failure must not abort the audit of the rest (same per-config
             # containment convention as bench/runner.run_sweep); it is still
@@ -717,8 +774,22 @@ def run_hlo_audit(
             continue
         report.findings.extend(findings)
         report.targets_audited.append(target.name)
+        if "schedule" in _meta:
+            report.schedule[target.name] = _meta["schedule"]
         if verbose:
             status = "FAIL" if findings else "ok"
+            sched = _meta.get("schedule")
+            n_coll = _meta.get(
+                "num_collectives",
+                sched["num_collectives"] if sched else 0,
+            )
+            extra = ""
+            if sched is not None:
+                eff = sched["overlap_efficiency"]
+                extra = (
+                    f", cp {sched['critical_path_us']:.1f}us"
+                    + (f", overlap {eff:.2f}" if eff is not None else "")
+                )
             print(f"[hlo] {target.name}: {status} "
-                  f"({_meta['num_collectives']} collective(s))")
+                  f"({n_coll} collective(s){extra})")
     return report

@@ -1,9 +1,25 @@
-"""Parser for collective instructions in compiled (post-SPMD) HLO text.
+"""Parser for compiled (post-SPMD) HLO text — instruction dependency graphs.
 
-The auditor reads ``jit(fn).lower(args).compile().as_text()`` — the
+The auditors read ``jit(fn).lower(args).compile().as_text()`` — the
 optimized HLO module *after* GSPMD partitioning — because that is where
 XLA-inserted collectives live; the pre-partitioning StableHLO only shows
 sharding annotations, not the all-gathers a sharding mismatch smuggles in.
+
+Two layers:
+
+- ``parse_module`` — the full instruction-dependency-graph parser: every
+  computation (entry, while bodies/conditions, conditional branches, fused
+  computations), every instruction with its operands, control
+  predecessors, called computations, async ``-start``/``-done`` pairing,
+  and the per-computation **execution count** (the product of enclosing
+  ``while`` known trip counts — a collective inside a scanned layer body
+  runs ``num_layers`` times, not once).  This is the substrate of the
+  schedule auditor (``schedule_audit.py``).
+- ``parse_collectives`` — the flat collective inventory the byte auditor
+  consumes, now built on ``parse_module`` so collectives in while-loop
+  bodies and nested computations carry their true ``execution_count``
+  (the bug the old line-oriented parser had: scanned-ring bodies were
+  charged one iteration of wire volume regardless of trip count).
 
 Instruction grammar handled (CPU and TPU backends emit the same shapes):
 
@@ -13,10 +29,16 @@ Instruction grammar handled (CPU and TPU backends emit the same shapes):
     ROOT %all-gather = f32[64,32]{1,0} all-gather(f32[8,32]{1,0} %dot), \
         channel_id=1, replica_groups=[1,8]<=[8], dimensions={0}, ...
     %collective-permute = ... , source_target_pairs={{0,1},{1,2}}
+    %while.3 = (...) while((...) %tuple), condition=%cond, body=%body, \
+        backend_config={"known_trip_count":{"n":"2"}}
 
 Both replica-group syntaxes are parsed: the explicit nested-brace list and
 the iota form ``[groups,size]<=[n]``.  Async pairs count once: the
-``-start`` op is parsed, the ``-done`` op is ignored.
+``-start`` op carries the payload, the ``-done`` op is ignored by the
+inventory (the graph keeps both, linked, for the overlap-window analysis).
+
+This module must stay importable WITHOUT jax — the source lint and the
+schedule auditor's unit tests run backend-free.
 """
 
 from __future__ import annotations
@@ -24,7 +46,7 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass, field
 from math import prod
-from typing import Optional
+from typing import Iterator, Optional, Union
 
 COLLECTIVE_KINDS = (
     "all-reduce",
@@ -43,45 +65,134 @@ _DTYPE_BYTES = {
     "c128": 16,
 }
 
-# the result type may be a variadic tuple with /*index=N*/ comments, so
-# the type group matches lazily up to the first collective keyword that is
-# directly followed by its operand paren
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%[\w.\-]+\s*=\s*(?P<type>\(?[a-z0-9]+\[.+?)\s"
-    r"(?P<kind>" + "|".join(COLLECTIVE_KINDS) + r")(?P<start>-start)?\("
-)
 _ARRAY_TYPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
 _GROUPS_BRACE_RE = re.compile(r"replica_groups=\{(\{[^=]*?\})\}(?=[,\s)]|$)")
 _GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
 _PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}(?=[,\s)]|$)")
 _META_RE = re.compile(r'source_file="([^"]+)"\s+source_line=(\d+)')
+_OP_NAME_RE = re.compile(r'op_name="([^"]+)"')
+_TRIP_COUNT_RE = re.compile(r'known_trip_count[^0-9]*"?n"?[^0-9]*(\d+)')
+_CONTROL_RE = re.compile(r"control-predecessors=\{([^}]*)\}")
+_OPERAND_NAME_RE = re.compile(r"%([\w.\-]+)")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+
+# computation header: ``%name (params) -> type {`` / ``ENTRY %main ... {``
+_COMP_HEADER_RE = re.compile(
+    r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*(?:\(.*)?\{\s*$"
+)
+_INSTR_START_RE = re.compile(
+    r"^(?P<root>ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$"
+)
+# called-computation attributes and the role they play for scheduling
+_CALL_ATTR_RE = re.compile(
+    r"(?P<role>condition|body|calls|to_apply|true_computation|"
+    r"false_computation|branch_computations)="
+    r"(?:\{(?P<many>[^}]*)\}|%(?P<one>[\w.\-]+))"
+)
+
+
+# ---------------------------------------------------------------------------
+# graph model
+# ---------------------------------------------------------------------------
 
 
 @dataclass
-class CollectiveInstr:
-    """One collective instruction in compiled HLO."""
+class HloInstruction:
+    """One instruction in a parsed HLO computation."""
 
-    kind: str                       # one of COLLECTIVE_KINDS
-    dtype: str                      # result element type (first array)
-    shape: tuple[int, ...]          # result shape (first array)
-    result_bytes: int               # summed over all result arrays
-    replica_groups: str             # raw groups / pairs text
-    group_count: Optional[int]
-    group_size: Optional[int]
-    source: Optional[str]           # "file:line" from HLO metadata
+    name: str
+    opcode: str                       # e.g. "dot", "all-gather-start"
+    dtype: str                        # result element type (first array)
+    shape: tuple[int, ...]            # result shape (first array)
+    arrays: list[tuple[str, tuple[int, ...]]]  # all result arrays
+    operands: tuple[str, ...]         # %names consumed (same computation)
+    operand_arrays: list[tuple[str, tuple[int, ...]]]  # operand types
+    control_deps: tuple[str, ...]     # control-predecessors
+    called: tuple[tuple[str, str], ...]  # (role, computation name)
+    is_root: bool = False
     raw: str = field(repr=False, default="")
+    # collective decoration (kind is None for non-collectives)
+    kind: Optional[str] = None        # base collective kind
+    is_start: bool = False
+    is_done: bool = False
+    replica_groups: str = ""
+    group_count: Optional[int] = None
+    group_size: Optional[int] = None
+    # metadata
+    source: Optional[str] = None      # "file:line"
+    op_name: Optional[str] = None     # jax name-stack, incl. named_scope
+    trip_count: Optional[int] = None  # while only: known_trip_count
+    lhs_contracting_dims: tuple[int, ...] = ()
 
-    def to_dict(self) -> dict:
-        return {
-            "kind": self.kind,
-            "dtype": self.dtype,
-            "shape": list(self.shape),
-            "result_bytes": self.result_bytes,
-            "replica_groups": self.replica_groups,
-            "group_count": self.group_count,
-            "group_size": self.group_size,
-            "source": self.source,
-        }
+    @property
+    def result_bytes(self) -> int:
+        return sum(_array_bytes(d, s) for d, s in self.arrays)
+
+    def collective_payload(self) -> tuple[int, str, tuple[int, ...]]:
+        """(payload bytes, dtype, shape) of a collective instruction.
+
+        Async ``-start`` ops return (operand, result, ...) scratch tuples;
+        the payload is the result array, whose size relative to the
+        operand depends on the kind: reduce-scatter shrinks by the group
+        size (result is the smallest element), all-gather grows (largest),
+        the rest are size-preserving (either extreme works).
+        """
+        if self.is_start and self.arrays:
+            sizes = [_array_bytes(d, s) for d, s in self.arrays]
+            pick = min if self.kind == "reduce-scatter" else max
+            idx = sizes.index(pick(sizes))
+            dtype, shape = self.arrays[idx]
+            return sizes[idx], dtype, shape
+        payload = sum(_array_bytes(d, s) for d, s in self.arrays)
+        dtype, shape = self.arrays[0] if self.arrays else ("", ())
+        return payload, dtype, shape
+
+
+@dataclass
+class HloComputation:
+    """One computation (entry, loop body/condition, branch, fusion)."""
+
+    name: str
+    is_entry: bool = False
+    instructions: list[HloInstruction] = field(default_factory=list)
+    # how many times this computation executes per module invocation:
+    # product of enclosing while trip counts along the call chain (1 when
+    # a trip count is unknown — the conservative floor)
+    execution_count: int = 1
+
+    def by_name(self) -> dict[str, HloInstruction]:
+        return {i.name: i for i in self.instructions}
+
+    @property
+    def root(self) -> Optional[HloInstruction]:
+        for i in self.instructions:
+            if i.is_root:
+                return i
+        return self.instructions[-1] if self.instructions else None
+
+
+@dataclass
+class HloModule:
+    """A parsed HLO module: the computation graph of one compiled program."""
+
+    computations: dict[str, HloComputation] = field(default_factory=dict)
+    entry: Optional[str] = None
+
+    def entry_computation(self) -> Optional[HloComputation]:
+        if self.entry is not None and self.entry in self.computations:
+            return self.computations[self.entry]
+        return next(iter(self.computations.values()), None)
+
+    def all_instructions(self) -> Iterator[tuple[HloComputation,
+                                                 HloInstruction]]:
+        for comp in self.computations.values():
+            for instr in comp.instructions:
+                yield comp, instr
+
+
+# ---------------------------------------------------------------------------
+# low-level text helpers
+# ---------------------------------------------------------------------------
 
 
 def _parse_arrays(type_text: str) -> list[tuple[str, tuple[int, ...]]]:
@@ -116,36 +227,276 @@ def _parse_groups(line: str) -> tuple[str, Optional[int], Optional[int]]:
     return "", None, None
 
 
-def parse_collectives(hlo_text: str) -> list[CollectiveInstr]:
-    """All collective instructions in an optimized-HLO module dump."""
-    out = []
-    for line in hlo_text.splitlines():
-        m = _INSTR_RE.match(line)
-        if m is None:
-            continue
-        arrays = _parse_arrays(m.group("type"))
-        kind = m.group("kind")
-        if m.group("start") and arrays:
-            # async start ops return (operand, result, ...) scratch tuples;
-            # the payload is the result array, whose size relative to the
-            # operand depends on the kind: reduce-scatter shrinks by the
-            # group size (result is the smallest element), all-gather grows
-            # (largest), the rest are size-preserving (either extreme works)
-            sizes = [_array_bytes(d, s) for d, s in arrays]
-            pick = min if kind == "reduce-scatter" else max
-            idx = sizes.index(pick(sizes))
-            payload = sizes[idx]
-            dtype, shape = arrays[idx]
+def _balanced_span(text: str, start: int) -> int:
+    """Index one past the bracket that closes ``text[start]`` (one of
+    ``([{``), honouring nesting of all three bracket kinds."""
+    depth = 0
+    opens, closes = "([{", ")]}"
+    for i in range(start, len(text)):
+        c = text[i]
+        if c in opens:
+            depth += 1
+        elif c in closes:
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return len(text)
+
+
+def _split_type(rest: str) -> tuple[str, str]:
+    """Split ``rest`` into (result-type text, remainder): the type is the
+    leading token — a possibly-tuple shape with layout braces — ending at
+    the first top-level whitespace."""
+    i = 0
+    while i < len(rest):
+        c = rest[i]
+        if c in "([{":
+            i = _balanced_span(rest, i)
+        elif c.isspace():
+            return rest[:i], rest[i:].lstrip()
         else:
-            payload = sum(_array_bytes(d, s) for d, s in arrays)
-            dtype, shape = arrays[0] if arrays else ("", ())
-        groups, count, size = _parse_groups(line)
-        meta = _META_RE.search(line)
-        source = f"{meta.group(1)}:{meta.group(2)}" if meta else None
+            i += 1
+    return rest, ""
+
+
+def _collective_of(opcode: str) -> tuple[Optional[str], bool, bool]:
+    """(base kind, is_start, is_done) for an opcode."""
+    for kind in COLLECTIVE_KINDS:
+        if opcode == kind:
+            return kind, False, False
+        if opcode == kind + "-start":
+            return kind, True, False
+        if opcode == kind + "-done":
+            return kind, False, True
+    return None, False, False
+
+
+def _parse_instruction(line: str) -> Optional[HloInstruction]:
+    s = line.strip()
+    m = _INSTR_START_RE.match(s)
+    if m is None:
+        return None
+    type_text, rest = _split_type(m.group("rest"))
+    om = re.match(r"[\w\-]+", rest)
+    if om is None:
+        return None
+    opcode = om.group(0)
+    after = rest[om.end():]
+    operands_text, attrs_text = "", after
+    if after.startswith("("):
+        end = _balanced_span(after, 0)
+        operands_text = after[1: end - 1]
+        attrs_text = after[end:]
+
+    arrays = _parse_arrays(type_text)
+    operand_arrays = _parse_arrays(operands_text)
+    operands = tuple(_OPERAND_NAME_RE.findall(operands_text))
+    ctrl = _CONTROL_RE.search(attrs_text)
+    control_deps = tuple(
+        _OPERAND_NAME_RE.findall(ctrl.group(1))) if ctrl else ()
+    called = []
+    for cm in _CALL_ATTR_RE.finditer(attrs_text):
+        role = cm.group("role")
+        if cm.group("one"):
+            called.append((role, cm.group("one")))
+        else:
+            for name in _OPERAND_NAME_RE.findall(cm.group("many") or ""):
+                called.append((role, name))
+    kind, is_start, is_done = _collective_of(opcode)
+    groups, count, size = _parse_groups(s) if kind else ("", None, None)
+    meta = _META_RE.search(s)
+    opn = _OP_NAME_RE.search(s)
+    trip = None
+    if opcode == "while":
+        tm = _TRIP_COUNT_RE.search(s)
+        trip = int(tm.group(1)) if tm else None
+    contract = _CONTRACT_RE.search(attrs_text)
+    lhs_dims = tuple(
+        int(d) for d in contract.group(1).split(",") if d
+    ) if contract else ()
+    return HloInstruction(
+        name=m.group("name"), opcode=opcode,
+        dtype=arrays[0][0] if arrays else "",
+        shape=arrays[0][1] if arrays else (),
+        arrays=arrays, operands=operands, operand_arrays=operand_arrays,
+        control_deps=control_deps, called=tuple(called),
+        is_root=bool(m.group("root")), raw=line,
+        kind=kind, is_start=is_start, is_done=is_done,
+        replica_groups=groups, group_count=count, group_size=size,
+        source=f"{meta.group(1)}:{meta.group(2)}" if meta else None,
+        op_name=opn.group(1) if opn else None,
+        trip_count=trip, lhs_contracting_dims=lhs_dims,
+    )
+
+
+# ---------------------------------------------------------------------------
+# module parsing
+# ---------------------------------------------------------------------------
+
+
+_BRANCH_ROLES = ("branch_computations", "true_computation",
+                 "false_computation")
+
+
+def _propagate_execution_counts(module: HloModule) -> None:
+    """Fill ``HloComputation.execution_count``: the entry runs once; a
+    while body runs ``known_trip_count`` times per call site (1 when
+    unknown — the conservative floor); plain calls/fusions run once per
+    caller execution.  Of a ``conditional``'s branches exactly ONE
+    executes per invocation — the first branch carries the call site's
+    count and the rest get 0, so inventories never charge both sides of
+    a conditional (the divergence check separately enforces that the
+    branches post identical collective sequences, which is what makes
+    counting one of them honest).  ``to_apply`` reducers are applied
+    elementwise and carry no schedulable work of their own, so they are
+    not walked (they contain no collectives)."""
+    # call edges caller -> [(callee, factor)]
+    edges: dict[str, list[tuple[str, int]]] = {}
+    indeg: dict[str, int] = {name: 0 for name in module.computations}
+    for comp in module.computations.values():
+        out = edges.setdefault(comp.name, [])
+        for instr in comp.instructions:
+            first_branch = True
+            for role, callee in instr.called:
+                if callee not in module.computations or role == "to_apply":
+                    continue
+                if role == "body":
+                    factor = instr.trip_count or 1
+                elif role in _BRANCH_ROLES:
+                    factor = 1 if first_branch else 0
+                    first_branch = False
+                else:
+                    factor = 1
+                out.append((callee, factor))
+                indeg[callee] += 1
+    counts = {name: 0 for name in module.computations}
+    referenced = {name: d > 0 for name, d in indeg.items()}
+    entry = module.entry_computation()
+    if entry is None:
+        return
+    counts[entry.name] = 1
+    # Kahn over the computation DAG, accumulating multipliers
+    queue = [n for n, d in indeg.items() if d == 0]
+    while queue:
+        name = queue.pop()
+        for callee, factor in edges.get(name, ()):
+            counts[callee] += counts[name] * factor
+            indeg[callee] -= 1
+            if indeg[callee] == 0:
+                queue.append(callee)
+    for name, comp in module.computations.items():
+        if referenced[name]:
+            # may legitimately be 0: a non-first conditional branch
+            comp.execution_count = counts[name]
+        else:
+            # unreferenced roots (the entry, standalone fixture
+            # fragments) run once
+            comp.execution_count = max(1, counts[name])
+
+
+def parse_module(hlo_text: str) -> HloModule:
+    """Parse an optimized-HLO module dump into its computation graph.
+
+    Tolerant of fragments: bare instruction lines outside any computation
+    header (the unit-test fixtures) land in an implicit entry computation
+    named ``<fragment>``."""
+    module = HloModule()
+    cur: Optional[HloComputation] = None
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        if not s or s.startswith("//") or s.startswith("HloModule"):
+            continue
+        if s.endswith("{") and _INSTR_START_RE.match(s) is None:
+            m = _COMP_HEADER_RE.match(s)
+            if m is not None:
+                cur = HloComputation(
+                    name=m.group("name"), is_entry=bool(m.group("entry")),
+                )
+                module.computations[cur.name] = cur
+                if cur.is_entry:
+                    module.entry = cur.name
+                continue
+        if s.startswith("}"):
+            cur = None
+            continue
+        instr = _parse_instruction(line)
+        if instr is None:
+            continue
+        if cur is None:
+            cur = module.computations.get("<fragment>")
+            if cur is None:
+                cur = HloComputation(name="<fragment>", is_entry=True)
+                module.computations["<fragment>"] = cur
+                if module.entry is None:
+                    module.entry = "<fragment>"
+        cur.instructions.append(instr)
+    _propagate_execution_counts(module)
+    return module
+
+
+# ---------------------------------------------------------------------------
+# flat collective inventory (byte-auditor surface)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class CollectiveInstr:
+    """One collective instruction in compiled HLO."""
+
+    kind: str                       # one of COLLECTIVE_KINDS
+    dtype: str                      # result element type (first array)
+    shape: tuple[int, ...]          # result shape (first array)
+    result_bytes: int               # summed over all result arrays
+    replica_groups: str             # raw groups / pairs text
+    group_count: Optional[int]
+    group_size: Optional[int]
+    source: Optional[str]           # "file:line" from HLO metadata
+    raw: str = field(repr=False, default="")
+    # graph decoration (new in the dependency-graph parser): how many
+    # times the instruction executes per module invocation (product of
+    # enclosing while trip counts), which computation holds it, and the
+    # jax name-stack (carries the ring_hop naming hooks)
+    execution_count: int = 1
+    computation: str = ""
+    name: str = ""
+    op_name: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "kind": self.kind,
+            "dtype": self.dtype,
+            "shape": list(self.shape),
+            "result_bytes": self.result_bytes,
+            "replica_groups": self.replica_groups,
+            "group_count": self.group_count,
+            "group_size": self.group_size,
+            "source": self.source,
+            "execution_count": self.execution_count,
+            "computation": self.computation,
+        }
+
+
+def parse_collectives(
+    hlo: Union[str, HloModule],
+) -> list[CollectiveInstr]:
+    """All collective instructions in an optimized-HLO module dump, across
+    EVERY computation — entry, while bodies, conditional branches — each
+    carrying its ``execution_count`` (enclosing while trip counts
+    multiplied in).  ``-done`` halves of async pairs are skipped; the
+    ``-start`` op carries the payload."""
+    module = hlo if isinstance(hlo, HloModule) else parse_module(hlo)
+    out = []
+    for comp, instr in module.all_instructions():
+        if instr.kind is None or instr.is_done:
+            continue
+        payload, dtype, shape = instr.collective_payload()
         out.append(CollectiveInstr(
-            kind=m.group("kind"), dtype=dtype, shape=shape,
-            result_bytes=payload, replica_groups=groups,
-            group_count=count, group_size=size, source=source, raw=line,
+            kind=instr.kind, dtype=dtype, shape=shape,
+            result_bytes=payload, replica_groups=instr.replica_groups,
+            group_count=instr.group_count, group_size=instr.group_size,
+            source=instr.source, raw=instr.raw,
+            execution_count=comp.execution_count,
+            computation=comp.name, name=instr.name, op_name=instr.op_name,
         ))
     return out
 

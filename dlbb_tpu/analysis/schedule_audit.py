@@ -1,0 +1,712 @@
+"""Pass 3 — HLO schedule auditor (α–β critical path + overlap proof).
+
+``hlo_audit`` proves *which* collectives a lowered program contains and
+*how many bytes* they move; this pass proves *when* they run.  On the
+instruction dependency graph (``hlo_parse.parse_module``) it computes,
+per audit target:
+
+- **Overlap verification** — for every collective (sync, or an async
+  ``-start``/``-done`` pair), the dense-compute instructions that can
+  execute concurrently with the transfer: instructions that are neither
+  ancestors nor descendants of the collective in the dependency order
+  (restricted, for async pairs, to the scheduled window strictly between
+  start and done).  A ring hop with **zero** straddling matmul FLOPs is a
+  ``serialized-collective`` finding on targets whose expectation claims
+  overlap (``TargetExpectation.expect_overlap`` — the PR-4 ring/bidir
+  collective-matmul schedules): it turns the overlap contract from
+  "≥ 4(tp−1) permutes exist" into "each hop is hidden".
+- **α–β critical path** — every instruction priced by the versioned
+  cost-model table (``costmodel.py``): collectives at
+  ``α(tier) + wire_bytes/β(tier)`` (analytic ring wire volume,
+  ``expectations.wire_bytes``), dense compute at ``FLOPs/peak``, nested
+  computations recursively (a ``while`` multiplies its body's critical
+  path by the known trip count).  Reported per target as
+  ``critical_path_us``, ``comm_on_critical_path_us`` and
+  ``overlap_efficiency`` (the fraction of total comm time that can hide
+  behind independent compute — an ASAP infinite-resource bound, so it is
+  an *upper* bound on achievable overlap and a hard zero for a
+  serialized schedule).
+- **Divergent-branch check** — the collective sequences reachable from
+  each branch of every ``conditional`` must be identical in kind +
+  replica groups: on a pod, ranks taking different branches would post
+  mismatched collectives and deadlock the slice.
+- **Regression baselines** — per-target snapshots of the inventory and
+  the critical-path numbers under ``stats/analysis/baselines/``;
+  ``analyze diff`` fails on unexplained growth (>10 % critical path or
+  wire volume, any new collective kind) and ``analyze snapshot``
+  regenerates them.  Baselines record the cost-model version + tier and
+  refuse to compare across either.
+
+Everything here is pure text/graph analysis — importable WITHOUT jax
+(only the lowering in ``hlo_audit`` needs a backend).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from math import prod
+from pathlib import Path
+from typing import Optional
+
+from dlbb_tpu.analysis.costmodel import (
+    COST_MODEL_VERSION,
+    CostTier,
+    collective_cost_us,
+    compute_cost_us,
+    get_tier,
+)
+from dlbb_tpu.analysis.expectations import TargetExpectation, wire_bytes
+from dlbb_tpu.analysis.findings import (
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    Finding,
+)
+from dlbb_tpu.analysis.hlo_parse import (
+    HloComputation,
+    HloInstruction,
+    HloModule,
+    parse_module,
+)
+
+# the naming hooks parallel/collective_matmul.py (ring_hop) and
+# comm/compression.py (qring_hop) put into the jax name stack: ring hops
+# are the instructions the overlap gate pins; qring hops are the
+# deliberately sequential quantised-ring hops (dequant-accumulate-requant
+# chains) and are exempt from it
+RING_HOP_MARK = "ring_hop"
+QRING_HOP_MARK = "qring_hop"
+
+# baseline-gate thresholds: growth beyond these fails `analyze diff`
+CRITICAL_PATH_SLACK = 1.10
+WIRE_SLACK = 1.10
+
+
+# ---------------------------------------------------------------------------
+# per-computation dependency-graph analysis
+# ---------------------------------------------------------------------------
+
+
+def _dot_flops(instr: HloInstruction) -> int:
+    """2 * prod(result) * prod(contracted lhs dims) for a ``dot``; 0 for
+    everything that is not dense compute."""
+    if instr.opcode != "dot" or not instr.operand_arrays:
+        return 0
+    lhs_shape = instr.operand_arrays[0][1]
+    contracted = prod(
+        lhs_shape[d] for d in instr.lhs_contracting_dims
+        if d < len(lhs_shape)
+    ) if instr.lhs_contracting_dims else 1
+    out = prod(instr.shape) if instr.shape else 1
+    return 2 * int(out) * int(contracted)
+
+
+def _fusion_flops(instr: HloInstruction, module: HloModule,
+                  memo: dict[str, int]) -> int:
+    """Dense FLOPs inside a fused computation (dots can be fused on TPU;
+    elementwise work is priced at zero — it is never what hides comm)."""
+    total = 0
+    for role, callee in instr.called:
+        if role != "calls" or callee not in module.computations:
+            continue
+        if callee not in memo:
+            memo[callee] = 0  # cycle guard (impossible in valid HLO)
+            memo[callee] = sum(
+                _instr_flops(i, module, memo)
+                for i in module.computations[callee].instructions
+            )
+        total += memo[callee]
+    return total
+
+
+def _instr_flops(instr: HloInstruction, module: HloModule,
+                 memo: dict[str, int]) -> int:
+    if instr.opcode == "dot":
+        return _dot_flops(instr)
+    if instr.opcode == "fusion":
+        return _fusion_flops(instr, module, memo)
+    return 0
+
+
+def _collective_wire(instr: HloInstruction) -> int:
+    payload, _, _ = instr.collective_payload()
+    return wire_bytes(instr.kind, payload, instr.group_size)
+
+
+@dataclass
+class _CompStats:
+    """Cached schedule analysis of one computation (single execution)."""
+
+    critical_path_us: float = 0.0
+    comm_on_cp_us: float = 0.0
+    comm_total_us: float = 0.0
+    compute_total_us: float = 0.0
+    hidden_comm_us: float = 0.0
+    collectives: list[dict] = field(default_factory=list)
+
+
+class _ModuleAnalysis:
+    """Schedule analysis over a parsed module with one cost tier."""
+
+    def __init__(self, module: HloModule, tier: CostTier):
+        self.module = module
+        self.tier = tier
+        self._flops_memo: dict[str, int] = {}
+        self._comp_memo: dict[str, _CompStats] = {}
+
+    # -- instruction pricing ------------------------------------------------
+
+    def _instr_cost(self, instr: HloInstruction) -> tuple[float, float]:
+        """(total cost, comm component) of one instruction, nested
+        computations included.  Async ``-done`` ops cost nothing (the
+        transfer is charged to the ``-start``, which is what makes the
+        start→…→done path carry the wire time)."""
+        if instr.kind is not None:
+            if instr.is_done:
+                return 0.0, 0.0
+            c = collective_cost_us(_collective_wire(instr), self.tier)
+            return c, c
+        if instr.opcode == "while":
+            body = cond = None
+            for role, callee in instr.called:
+                if role == "body":
+                    body = callee
+                elif role == "condition":
+                    cond = callee
+            trip = instr.trip_count or 1
+            cost = comm = 0.0
+            if body in self.module.computations:
+                s = self._analyze_comp(self.module.computations[body])
+                cost += trip * s.critical_path_us
+                comm += trip * s.comm_on_cp_us
+            if cond in self.module.computations:
+                s = self._analyze_comp(self.module.computations[cond])
+                cost += trip * s.critical_path_us
+                comm += trip * s.comm_on_cp_us
+            return cost, comm
+        if instr.opcode == "conditional":
+            best = (0.0, 0.0)
+            for role, callee in instr.called:
+                if callee in self.module.computations and role in (
+                        "branch_computations", "true_computation",
+                        "false_computation"):
+                    s = self._analyze_comp(self.module.computations[callee])
+                    if s.critical_path_us > best[0]:
+                        best = (s.critical_path_us, s.comm_on_cp_us)
+            return best
+        if instr.opcode in ("call", "async-start"):
+            cost = comm = 0.0
+            for role, callee in instr.called:
+                if role == "calls" and callee in self.module.computations:
+                    s = self._analyze_comp(self.module.computations[callee])
+                    cost += s.critical_path_us
+                    comm += s.comm_on_cp_us
+            return cost, comm
+        flops = _instr_flops(instr, self.module, self._flops_memo)
+        if flops:
+            return compute_cost_us(flops, self.tier), 0.0
+        return 0.0, 0.0
+
+    # -- per-computation DAG analysis ---------------------------------------
+
+    def _analyze_comp(self, comp: HloComputation) -> _CompStats:
+        cached = self._comp_memo.get(comp.name)
+        if cached is not None:
+            return cached
+        # cycle guard: self-referential HLO is invalid, but a truncated
+        # dump must not hang the auditor
+        self._comp_memo[comp.name] = _CompStats()
+
+        instrs = comp.instructions
+        idx = {i.name: n for n, i in enumerate(instrs)}
+        deps: list[list[int]] = [
+            sorted({idx[o] for o in (*i.operands, *i.control_deps)
+                    if o in idx and idx[o] != n})
+            for n, i in enumerate(instrs)
+        ]
+        order = _topo_order(len(instrs), deps)
+
+        costs = [self._instr_cost(i) for i in instrs]
+        flops = [
+            _instr_flops(i, self.module, self._flops_memo) for i in instrs
+        ]
+
+        # ancestor bitsets in topo order (operand + control edges)
+        anc = [0] * len(instrs)
+        for n in order:
+            a = 0
+            for d in deps[n]:
+                a |= anc[d] | (1 << d)
+            anc[n] = a
+
+        # ASAP longest-path arrival times + comm time along the argmax path
+        finish = [0.0] * len(instrs)
+        comm_on_path = [0.0] * len(instrs)
+        for n in order:
+            start, comm = 0.0, 0.0
+            for d in deps[n]:
+                if finish[d] > start:
+                    start, comm = finish[d], comm_on_path[d]
+            finish[n] = start + costs[n][0]
+            comm_on_path[n] = comm + costs[n][1]
+        stats = _CompStats()
+        if instrs:
+            end = max(range(len(instrs)), key=lambda n: finish[n])
+            stats.critical_path_us = finish[end]
+            stats.comm_on_cp_us = comm_on_path[end]
+        stats.compute_total_us = sum(
+            compute_cost_us(f, self.tier) for f in flops if f
+        )
+
+        # async pairing: done instruction consuming a start's value
+        done_pos: dict[int, int] = {}
+        for n, i in enumerate(instrs):
+            if i.kind is not None and i.is_done:
+                for o in i.operands:
+                    s = idx.get(o)
+                    if s is not None and instrs[s].is_start:
+                        done_pos[s] = n
+
+        # per-collective overlap: compute independent of the collective
+        # (neither ancestor nor descendant), window-restricted for async
+        # pairs to the instructions scheduled strictly between start/done
+        for n, i in enumerate(instrs):
+            if i.kind is None or i.is_done:
+                continue
+            cost = costs[n][0]
+            lo, hi = 0, len(instrs)
+            if n in done_pos:
+                lo, hi = n + 1, done_pos[n]
+            indep_us, indep_flops = 0.0, 0
+            for m in range(lo, hi):
+                if not flops[m] or m == n:
+                    continue
+                if (anc[n] >> m) & 1 or (anc[m] >> n) & 1:
+                    continue
+                indep_us += compute_cost_us(flops[m], self.tier)
+                indep_flops += flops[m]
+            op_name = i.op_name or ""
+            stats.collectives.append({
+                "name": i.name,
+                "kind": i.kind,
+                "cost_us": cost,
+                "wire_bytes": _collective_wire(i),
+                "straddling_flops": indep_flops,
+                "straddling_compute_us": indep_us,
+                "hidden_us": min(cost, indep_us),
+                "async": n in done_pos,
+                "is_ring_hop": (RING_HOP_MARK in op_name
+                                and QRING_HOP_MARK not in op_name),
+                "op_name": i.op_name,
+                "source": i.source,
+                "computation": comp.name,
+            })
+        stats.comm_total_us = sum(c["cost_us"] for c in stats.collectives)
+        stats.hidden_comm_us = sum(c["hidden_us"] for c in stats.collectives)
+        self._comp_memo[comp.name] = stats
+        return stats
+
+    # -- module-level aggregation -------------------------------------------
+
+    def analyze(self) -> dict:
+        entry = self.module.entry_computation()
+        if entry is None:
+            return {
+                "cost_model_version": COST_MODEL_VERSION,
+                "tier": self.tier.name,
+                "critical_path_us": 0.0,
+                "comm_on_critical_path_us": 0.0,
+                "comm_total_us": 0.0,
+                "compute_total_us": 0.0,
+                "overlap_efficiency": None,
+                "total_wire_bytes": 0,
+                "num_collectives": 0,
+                "collective_kinds": {},
+                "collectives": [],
+            }
+        entry_stats = self._analyze_comp(entry)
+        # fused computations are priced at their fusion call site
+        # (_fusion_flops feeds the caller's flops[] and compute_total);
+        # walking them again here would double-count their dots.  They
+        # can never hold collectives, so skipping them drops nothing.
+        fused = {
+            callee
+            for _, instr in self.module.all_instructions()
+            if instr.opcode == "fusion"
+            for role, callee in instr.called if role == "calls"
+        }
+        comm_total = hidden = compute_total = 0.0
+        total_wire = 0
+        kinds: dict[str, int] = {}
+        collectives: list[dict] = []
+        for comp in self.module.computations.values():
+            if comp.name in fused:
+                continue
+            s = self._analyze_comp(comp)
+            mult = comp.execution_count
+            comm_total += mult * s.comm_total_us
+            hidden += mult * s.hidden_comm_us
+            compute_total += mult * s.compute_total_us
+            for c in s.collectives:
+                total_wire += mult * c["wire_bytes"]
+                if mult:
+                    # mult 0 = a non-first conditional branch: keep the
+                    # instruction in the inventory (it is still schedule-
+                    # checked) but charge it nothing
+                    kinds[c["kind"]] = kinds.get(c["kind"], 0) + mult
+                collectives.append({**c, "execution_count": mult})
+        return {
+            "cost_model_version": COST_MODEL_VERSION,
+            "tier": self.tier.name,
+            "critical_path_us": round(entry_stats.critical_path_us, 6),
+            "comm_on_critical_path_us": round(entry_stats.comm_on_cp_us, 6),
+            "comm_total_us": round(comm_total, 6),
+            "compute_total_us": round(compute_total, 6),
+            "overlap_efficiency": (
+                round(hidden / comm_total, 6) if comm_total > 0 else None
+            ),
+            "total_wire_bytes": total_wire,
+            "num_collectives": sum(kinds.values()),
+            "collective_kinds": dict(sorted(kinds.items())),
+            "collectives": collectives,
+        }
+
+
+def _topo_order(n: int, deps: list[list[int]]) -> list[int]:
+    """Kahn topological order (text order is already topological in
+    scheduled HLO, but a defensive sort keeps synthetic fixtures honest).
+    Nodes in dependency cycles (invalid HLO) are appended in text order so
+    the analysis degrades instead of dropping instructions."""
+    indeg = [0] * n
+    out: list[list[int]] = [[] for _ in range(n)]
+    for i, ds in enumerate(deps):
+        for d in ds:
+            out[d].append(i)
+            indeg[i] += 1
+    queue = [i for i in range(n) if indeg[i] == 0]
+    order: list[int] = []
+    while queue:
+        i = queue.pop()
+        order.append(i)
+        for j in out[i]:
+            indeg[j] -= 1
+            if indeg[j] == 0:
+                queue.append(j)
+    if len(order) < n:
+        seen = set(order)
+        order.extend(i for i in range(n) if i not in seen)
+    return order
+
+
+# ---------------------------------------------------------------------------
+# divergent-branch (cross-shard deadlock) check
+# ---------------------------------------------------------------------------
+
+
+def _collective_signature(module: HloModule, comp_name: str,
+                          _seen: Optional[set] = None) -> list[tuple]:
+    """Ordered (kind, replica_groups) sequence posted by one computation,
+    recursing through calls / loop bodies (trip-count-expanded) — the
+    thing that must match across conditional branches for all shards to
+    agree on the collective schedule."""
+    if _seen is None:
+        _seen = set()
+    if comp_name in _seen or comp_name not in module.computations:
+        return []
+    _seen = _seen | {comp_name}
+    sig: list[tuple] = []
+    for instr in module.computations[comp_name].instructions:
+        if instr.kind is not None and not instr.is_done:
+            sig.append((instr.kind, instr.replica_groups))
+        for role, callee in instr.called:
+            if role == "to_apply":
+                continue
+            reps = (instr.trip_count or 1) if role == "body" else 1
+            child = _collective_signature(module, callee, _seen)
+            sig.extend(child * reps)
+    return sig
+
+
+def _check_divergent_branches(module: HloModule, target: str,
+                              findings: list[Finding]) -> None:
+    for comp, instr in module.all_instructions():
+        if instr.opcode != "conditional":
+            continue
+        branches = [
+            (callee, _collective_signature(module, callee))
+            for role, callee in instr.called
+            if role in ("branch_computations", "true_computation",
+                        "false_computation")
+        ]
+        if len(branches) < 2:
+            continue
+        base_name, base_sig = branches[0]
+        for name, sig in branches[1:]:
+            if sig != base_sig:
+                findings.append(Finding(
+                    pass_name="schedule",
+                    rule="divergent-branch-collectives",
+                    severity=SEVERITY_ERROR,
+                    target=target,
+                    message=(
+                        f"conditional {instr.name} posts different "
+                        f"collective sequences per branch ({base_name}: "
+                        f"{len(base_sig)} vs {name}: {len(sig)}) — on a "
+                        "pod, shards taking different branches would "
+                        "post mismatched collectives and deadlock the "
+                        "slice; hoist the collectives out of the branch "
+                        "or make the sequences identical in kind + "
+                        "replica groups"
+                    ),
+                    location=instr.source,
+                    details={
+                        "conditional": instr.name,
+                        "computation": comp.name,
+                        "branches": {
+                            base_name: [list(t) for t in base_sig],
+                            name: [list(t) for t in sig],
+                        },
+                    },
+                ))
+                break
+
+
+# ---------------------------------------------------------------------------
+# the schedule pass (per audit target)
+# ---------------------------------------------------------------------------
+
+
+def analyze_schedule(
+    hlo: "str | HloModule",
+    expectation: TargetExpectation,
+    target: str,
+    tier: Optional[str] = None,
+) -> tuple[list[Finding], dict]:
+    """Run the schedule audit over one compiled module.  Returns the
+    findings plus the per-target schedule meta (the JSON-report /
+    baseline payload)."""
+    module = hlo if isinstance(hlo, HloModule) else parse_module(hlo)
+    cost_tier = get_tier(tier)
+    findings: list[Finding] = []
+
+    meta = _ModuleAnalysis(module, cost_tier).analyze()
+    _check_divergent_branches(module, target, findings)
+
+    if expectation.expect_overlap:
+        hops = [c for c in meta["collectives"] if c["is_ring_hop"]]
+        if not hops:
+            # naming hooks absent (e.g. a hand-built fixture): fall back
+            # to every permute — the overlap contract is about the ring
+            hops = [c for c in meta["collectives"]
+                    if c["kind"] == "collective-permute"]
+        serialized = [c for c in hops if c["straddling_flops"] == 0]
+        meta["ring_hops"] = {
+            "total": len(hops),
+            "straddled": len(hops) - len(serialized),
+        }
+        for c in serialized:
+            findings.append(Finding(
+                pass_name="schedule",
+                rule="serialized-collective",
+                severity=SEVERITY_ERROR,
+                target=target,
+                message=(
+                    f"ring hop {c['name']} ({c['kind']}, "
+                    f"{c['wire_bytes']} wire B) has no straddling "
+                    "matmul — no dense compute is independent of the "
+                    "transfer, so the hop serialises into the critical "
+                    "path and the overlap claim is void for this "
+                    "schedule"
+                ),
+                location=c["source"],
+                details={k: c[k] for k in (
+                    "name", "kind", "cost_us", "wire_bytes",
+                    "straddling_flops", "computation", "op_name",
+                )},
+            ))
+    return findings, meta
+
+
+# ---------------------------------------------------------------------------
+# regression baselines (snapshot / diff gate)
+# ---------------------------------------------------------------------------
+
+DEFAULT_BASELINE_DIR = Path("stats/analysis/baselines")
+
+# keys of the schedule meta that are snapshotted and diffed
+_BASELINE_KEYS = (
+    "cost_model_version", "tier", "critical_path_us",
+    "comm_on_critical_path_us", "comm_total_us", "compute_total_us",
+    "overlap_efficiency", "total_wire_bytes", "num_collectives",
+    "collective_kinds",
+)
+
+
+def baseline_path(directory: Path, target: str) -> Path:
+    """File for one target's snapshot: the target name slugified (exact
+    name kept inside the JSON)."""
+    slug = re.sub(r"[^\w.]+", "_", target).strip("_")
+    return Path(directory) / f"{slug}.json"
+
+
+def snapshot_baselines(schedule_meta: dict[str, dict],
+                       directory: Path,
+                       skipped_targets: tuple[str, ...] = ()) -> list[Path]:
+    """Write one baseline JSON per audited target; returns the paths.
+    Stale snapshots for targets that no longer exist are removed so the
+    committed directory always mirrors the audit surface — but a target
+    merely SKIPPED this run (insufficient devices, e.g. a snapshot taken
+    on a small host) keeps its committed baseline: pruning it would make
+    the next full-mesh ``analyze diff`` fail missing-baseline on every
+    target the small host could not audit."""
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    written: list[Path] = []
+    keep = {
+        baseline_path(directory, t).name for t in skipped_targets
+    }
+    for target in sorted(schedule_meta):
+        meta = schedule_meta[target]
+        payload = {"target": target}
+        payload.update({k: meta.get(k) for k in _BASELINE_KEYS})
+        path = baseline_path(directory, target)
+        keep.add(path.name)
+        atomic_write_text(
+            json.dumps(payload, indent=2, sort_keys=True) + "\n", path
+        )
+        written.append(path)
+    for stale in sorted(directory.glob("*.json")):
+        if stale.name not in keep:
+            stale.unlink()
+    return written
+
+
+def load_baselines(directory: Path) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    for path in sorted(Path(directory).glob("*.json")):
+        data = json.loads(path.read_text())
+        out[data["target"]] = data
+    return out
+
+
+def diff_baselines(
+    schedule_meta: dict[str, dict],
+    directory: Path,
+    skipped_targets: tuple[str, ...] = (),
+) -> list[Finding]:
+    """Compare one audit run against the committed snapshots.  Errors on
+    unexplained growth (> 10 % critical path or wire volume, any new
+    collective kind), on a target with no snapshot, and on cost-model
+    version/tier skew; warns (never fails CI) when the numbers *improved*
+    enough that a re-snapshot would tighten the gate."""
+    findings: list[Finding] = []
+    directory = Path(directory)
+    baselines = load_baselines(directory) if directory.is_dir() else {}
+    if not baselines:
+        findings.append(Finding(
+            pass_name="schedule", rule="missing-baseline",
+            severity=SEVERITY_ERROR, target=str(directory),
+            message=(
+                f"no committed schedule baselines under {directory} — "
+                "run `python -m dlbb_tpu.cli analyze snapshot "
+                "--simulate 8` and commit the result"
+            ),
+        ))
+        return findings
+
+    for target in sorted(schedule_meta):
+        cur = schedule_meta[target]
+        base = baselines.get(target)
+        if base is None:
+            findings.append(Finding(
+                pass_name="schedule", rule="missing-baseline",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    "audited target has no committed baseline snapshot — "
+                    "a new target must land with its expectation: run "
+                    "`analyze snapshot` and commit "
+                    f"{baseline_path(directory, target)}"
+                ),
+            ))
+            continue
+        if (base.get("cost_model_version") != cur.get("cost_model_version")
+                or base.get("tier") != cur.get("tier")):
+            findings.append(Finding(
+                pass_name="schedule", rule="cost-model-mismatch",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"baseline priced with cost model "
+                    f"{base.get('cost_model_version')}/{base.get('tier')} "
+                    f"but this run uses {cur.get('cost_model_version')}/"
+                    f"{cur.get('tier')} — numbers are not comparable; "
+                    "re-snapshot after a cost-model change"
+                ),
+            ))
+            continue
+        new_kinds = sorted(
+            set(cur.get("collective_kinds", {}))
+            - set(base.get("collective_kinds", {}))
+        )
+        if new_kinds:
+            findings.append(Finding(
+                pass_name="schedule", rule="new-collective-kind",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"collective kind(s) {new_kinds} appear that the "
+                    "baseline does not contain — a sharding change "
+                    "introduced a new communication pattern; explain it "
+                    "and re-snapshot, or fix the sharding"
+                ),
+                details={
+                    "new_kinds": new_kinds,
+                    "baseline_kinds": base.get("collective_kinds", {}),
+                    "current_kinds": cur.get("collective_kinds", {}),
+                },
+            ))
+        for key, slack, rule in (
+            ("critical_path_us", CRITICAL_PATH_SLACK,
+             "critical-path-regression"),
+            ("total_wire_bytes", WIRE_SLACK, "wire-volume-regression"),
+        ):
+            b, c = base.get(key), cur.get(key)
+            if not b or c is None:
+                continue
+            if c > b * slack:
+                findings.append(Finding(
+                    pass_name="schedule", rule=rule,
+                    severity=SEVERITY_ERROR, target=target,
+                    message=(
+                        f"{key} grew {c / b:.2f}x over the committed "
+                        f"baseline ({b} -> {c}, gate at {slack:.2f}x) — "
+                        "unexplained schedule regression; investigate, "
+                        "then re-snapshot if the growth is intended"
+                    ),
+                    details={"key": key, "baseline": b, "current": c,
+                             "ratio": round(c / b, 4)},
+                ))
+            elif c < b / slack and key == "critical_path_us":
+                findings.append(Finding(
+                    pass_name="schedule", rule="baseline-improved",
+                    severity=SEVERITY_WARNING, target=target,
+                    message=(
+                        f"{key} improved {b / max(c, 1e-9):.2f}x under "
+                        "the committed baseline — re-snapshot to tighten "
+                        "the regression gate"
+                    ),
+                    details={"key": key, "baseline": b, "current": c},
+                ))
+    audited = set(schedule_meta) | set(skipped_targets)
+    for target in sorted(set(baselines) - audited):
+        findings.append(Finding(
+            pass_name="schedule", rule="stale-baseline",
+            severity=SEVERITY_WARNING, target=target,
+            message=(
+                "committed baseline has no matching audit target — the "
+                "target was removed or renamed; run `analyze snapshot` "
+                "to prune"
+            ),
+        ))
+    return findings

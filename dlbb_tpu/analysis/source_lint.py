@@ -22,6 +22,13 @@ distributed benchmark repo cares about and generic linters do not:
   a set literal / ``set(...)`` call — hash-order dependent, so publish
   scripts reprocess artifacts in a different order run to run (the
   round-5 ADVICE nondeterminism finding, generalised).
+- ``wallclock-in-timed-region``: ``time.time()`` / ``datetime.now()`` /
+  ``datetime.utcnow()`` inside a timed region.  The wall clock is
+  non-monotonic — NTP can step it mid-measurement — so a benchmark
+  number derived from it is unfalsifiable; timed regions must read
+  ``time.perf_counter()`` only (wall-clock *timestamps* belong outside
+  the region).  Unlike host syncs there is no bracketing exemption: a
+  wall-clock read is wrong anywhere inside the region.
 - ``non-atomic-artifact-write``: a bare ``json.dump(...)`` (in-place
   write of the destination file) or ``*.write_text(json.dumps(...))``
   outside the sanctioned atomic helper (``utils/config.py``:
@@ -57,6 +64,7 @@ from dlbb_tpu.analysis.findings import (
 
 LINT_RULES = (
     "host-sync-in-timed-region",
+    "wallclock-in-timed-region",
     "missing-donation",
     "jit-in-loop",
     "unsorted-set-iteration",
@@ -77,6 +85,13 @@ TIMING_API_NAMES = {
 _SYNC_CALL_NAMES = {"block_until_ready", "device_get"}
 _SYNC_WRAPPERS = {"float", "int"}
 _NP_SYNC_ATTRS = {"asarray", "array"}
+# wall-clock reads (non-monotonic) that must never supply a timed-region
+# measurement; perf_counter/monotonic are the sanctioned clocks
+_WALLCLOCK_NAMES = {
+    "time.time", "datetime.now", "datetime.utcnow",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "date.today", "datetime.date.today",
+}
 
 
 # ---------------------------------------------------------------------------
@@ -169,6 +184,14 @@ def _sync_calls(stmt: ast.stmt) -> Iterable[tuple[ast.Call, str]]:
             yield node, ".item()"
 
 
+def _wallclock_calls(stmt: ast.stmt) -> Iterable[tuple[ast.Call, str]]:
+    """(call, description) for every wall-clock read inside ``stmt``."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and _call_name(
+                node) in _WALLCLOCK_NAMES:
+            yield node, f"{_call_name(node)}()"
+
+
 # ---------------------------------------------------------------------------
 # rule implementations
 # ---------------------------------------------------------------------------
@@ -206,6 +229,25 @@ def _check_timed_with(node: ast.With, path: str, findings: list[Finding]):
                 location=f"{path}:{call.lineno}",
                 details={"sync": desc, "region": f"with Timer() at line "
                                                  f"{node.lineno}"},
+            ))
+        # no bracketing exemption: a wall-clock read is wrong anywhere
+        # inside the region, last statement included
+        for call, desc in _wallclock_calls(stmt):
+            findings.append(Finding(
+                pass_name="lint",
+                rule="wallclock-in-timed-region",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    f"{desc} inside a Timer block reads the wall clock — "
+                    "non-monotonic (NTP can step it mid-measurement), so "
+                    "any duration derived from it is unfalsifiable; use "
+                    "time.perf_counter(), and take wall-clock timestamps "
+                    "outside the timed region"
+                ),
+                location=f"{path}:{call.lineno}",
+                details={"clock": desc, "region": f"with Timer() at line "
+                                                  f"{node.lineno}"},
             ))
 
 
@@ -260,6 +302,27 @@ def _check_perf_counter_regions(tree: ast.AST, path: str,
                                 ),
                                 location=f"{path}:{call.lineno}",
                                 details={"sync": desc,
+                                         "region": f"perf_counter span "
+                                                   f"'{var}'"},
+                            ))
+                    # wall-clock reads get no bracketing exemption (the
+                    # statement before the delta included)
+                    for mid in blk[start + 1: idx]:
+                        for call, desc in _wallclock_calls(mid):
+                            findings.append(Finding(
+                                pass_name="lint",
+                                rule="wallclock-in-timed-region",
+                                severity=SEVERITY_ERROR,
+                                target=path,
+                                message=(
+                                    f"{desc} between "
+                                    f"{var} = time.perf_counter() and its "
+                                    "delta reads the non-monotonic wall "
+                                    "clock; use time.perf_counter() and "
+                                    "timestamp outside the region"
+                                ),
+                                location=f"{path}:{call.lineno}",
+                                details={"clock": desc,
                                          "region": f"perf_counter span "
                                                    f"'{var}'"},
                             ))

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dlbb_tpu.analysis.costmodel import COST_MODEL_VERSION
 from dlbb_tpu.bench import schedule
 from dlbb_tpu.comm.mesh import get_mesh
 from dlbb_tpu.comm.ops import (
@@ -741,6 +742,11 @@ def _run_sweep_configured(
             "implementation": impl,
             "variant": variant.name,
             "topology": topology,
+            # the α–β table version (analysis/costmodel.py) current when
+            # this sweep ran: artifacts feed the fitted cost model
+            # (ROADMAP item 2), and a fit must know which analytic seed
+            # its residuals are priced against
+            "cost_model_version": COST_MODEL_VERSION,
             "timing_mode": mode,
             "pipeline": scheduler.pipelined,
             "prefetch": sweep.prefetch,

@@ -137,13 +137,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     an = sub.add_parser(
         "analyze",
-        help="comm-lint: static HLO collective audit + source lint "
-             "(verifies benchmarks match their parallelism plan, no TPU "
-             "needed — runs on the --simulate mesh)",
+        help="comm-lint: static HLO collective audit, α–β schedule audit, "
+             "and source lint (verifies benchmarks match their "
+             "parallelism plan, no TPU needed — runs on the --simulate "
+             "mesh).  Exit codes are a pinned contract: 0 clean / "
+             "1 findings / 2 crash (docs/schedule_audit.md)",
     )
     an.add_argument("which", nargs="?", default="all",
-                    choices=("hlo", "lint", "all"),
-                    help="which pass to run (default: all)")
+                    choices=("hlo", "lint", "schedule", "all",
+                             "snapshot", "diff"),
+                    help="pass to run: hlo = collective byte audit, "
+                         "schedule = α–β critical-path/overlap audit, "
+                         "lint = AST source lint, all = every pass "
+                         "(default); snapshot = (re)write the schedule "
+                         "regression baselines, diff = fail on "
+                         "unexplained drift from the committed baselines")
     an.add_argument("--simulate", type=int, default=0, metavar="N",
                     help="use an N-device CPU-simulated mesh for the HLO "
                          "audit (targets needing more devices than "
@@ -154,6 +162,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="repo root for the source lint (default: cwd)")
     an.add_argument("--strict-warnings", action="store_true",
                     help="exit nonzero on warnings too")
+    an.add_argument("--baselines", default=None, metavar="DIR",
+                    help="schedule-baseline directory for snapshot/diff "
+                         "(default: stats/analysis/baselines)")
+    an.add_argument("--tier", default=None, metavar="TIER",
+                    help="cost-model link tier for the schedule audit "
+                         "(cpu-sim, tpu-v5lite, tpu-v5lite-dcn; default: "
+                         "auto from the backend — see "
+                         "analysis/costmodel.py)")
 
     ch = sub.add_parser(
         "chaos",
@@ -443,6 +459,7 @@ def _dispatch(args) -> int:
         return run_analysis(
             which=args.which, root=args.root, json_path=args.json,
             strict_warnings=args.strict_warnings,
+            baselines=args.baselines, tier=args.tier,
         )
 
     if args.cmd == "chaos":

@@ -165,16 +165,23 @@ def _ring_reduce(
     visits every rank exactly once).  Each hop ppermutes the quantised
     partial AND its scale tensor (two collective-permutes per hop — the
     scale side channel is real wire traffic and is audited as such).
+
+    Hops run under ``qring_hop*`` named scopes: unlike the collective-
+    matmul ``ring_hop`` hops, this ring is *deliberately* sequential
+    (each hop's dequant-accumulate-requant feeds the next), so the
+    schedule auditor must be able to tell them apart — qring hops are
+    exempt from the serialized-collective overlap gate.
     """
     fwd = [(i, (i + 1) % p) for i in range(p)]
     part = local_chunk(0).astype(accum_dtype)
     for s in range(1, p):
         q, scales = quantize_chunked(part, compression)
-        q = _from_wire(
-            lax.ppermute(_to_wire(q, compression), axis_name, fwd),
-            compression,
-        )
-        scales = lax.ppermute(scales, axis_name, fwd)
+        with jax.named_scope(f"qring_hop{s}"):
+            q = _from_wire(
+                lax.ppermute(_to_wire(q, compression), axis_name, fwd),
+                compression,
+            )
+            scales = lax.ppermute(scales, axis_name, fwd)
         incoming = dequantize_chunked(
             q, scales, part.shape[-1], accum_dtype
         )

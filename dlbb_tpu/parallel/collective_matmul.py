@@ -98,10 +98,15 @@ def _ring_visit(travelling, axis: str, p: int, bidir: bool, visit):
     r = lax.axis_index(axis)
     fwd, bwd = _ring_perms(p)
     visit(travelling, r)
+    # every hop runs under a ``ring_hop*`` named scope: the name lands in
+    # the HLO op_name metadata, which is how the schedule auditor
+    # (analysis/schedule_audit.py) pins exactly these permutes for the
+    # serialized-collective gate — each must have a straddling matmul
     if not bidir:
         cur = travelling
         for j in range(1, p):
-            cur = lax.ppermute(cur, axis, fwd)   # now holds block (r - j)
+            with jax.named_scope(f"ring_hop_fwd{j}"):
+                cur = lax.ppermute(cur, axis, fwd)  # holds block (r - j)
             visit(cur, (r - j) % p)
         return
     n_fwd = (p - 1 + 1) // 2
@@ -109,10 +114,12 @@ def _ring_visit(travelling, axis: str, p: int, bidir: bool, visit):
     cur_f = cur_b = travelling
     for j in range(1, max(n_fwd, n_bwd) + 1):
         if j <= n_fwd:
-            cur_f = lax.ppermute(cur_f, axis, fwd)   # block (r - j)
+            with jax.named_scope(f"ring_hop_fwd{j}"):
+                cur_f = lax.ppermute(cur_f, axis, fwd)   # block (r - j)
             visit(cur_f, (r - j) % p)
         if j <= n_bwd:
-            cur_b = lax.ppermute(cur_b, axis, bwd)   # block (r + j)
+            with jax.named_scope(f"ring_hop_bwd{j}"):
+                cur_b = lax.ppermute(cur_b, axis, bwd)   # block (r + j)
             visit(cur_b, (r + j) % p)
 
 
@@ -160,10 +167,11 @@ def _matmul_rs_body(x, w, axis: str, p: int, bidir: bool):
     if not bidir:
         # target of the accumulator on this device at add-step j is
         # (r + p - 1 - j) mod p; after the last add it is chunk r, fully
-        # reduced
+        # reduced.  ring_hop named scopes: see _ring_visit
         acc = partial((r + p - 1) % p, w)
         for j in range(1, p):
-            acc = lax.ppermute(acc, axis, fwd)
+            with jax.named_scope(f"ring_hop_fwd{j}"):
+                acc = lax.ppermute(acc, axis, fwd)
             acc = acc + partial((r + p - 1 - j) % p, w)
         return acc
     # bidirectional: front half of the output features reduces clockwise,
@@ -174,9 +182,11 @@ def _matmul_rs_body(x, w, axis: str, p: int, bidir: bool):
     acc_f = partial((r + p - 1) % p, w_f)
     acc_b = partial((r + 1) % p, w_b)
     for j in range(1, p):
-        acc_f = lax.ppermute(acc_f, axis, fwd)
+        with jax.named_scope(f"ring_hop_fwd{j}"):
+            acc_f = lax.ppermute(acc_f, axis, fwd)
         acc_f = acc_f + partial((r + p - 1 - j) % p, w_f)
-        acc_b = lax.ppermute(acc_b, axis, bwd)
+        with jax.named_scope(f"ring_hop_bwd{j}"):
+            acc_b = lax.ppermute(acc_b, axis, bwd)
         acc_b = acc_b + partial((r + 1 + j) % p, w_b)
     return jnp.concatenate([acc_f, acc_b], axis=-1)
 

@@ -18,6 +18,20 @@ JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze all --simulate 8 \
 JAX_PLATFORMS=cpu python -m pytest tests/test_analysis.py -q -m 'not slow' \
     -p no:cacheprovider
 
+# schedule_smoke (docs/schedule_audit.md): the α–β schedule audit runs
+# INSIDE `analyze all` above (one lowering serves the byte + schedule
+# passes: every ring hop must be hidden behind a straddling matmul, no
+# divergent-branch collective sequences).  `analyze diff` re-audits once
+# for the regression-baseline gate against the committed
+# stats/analysis/baselines/ snapshots (fails on >10% critical-path /
+# wire growth or any new collective kind; `analyze snapshot` regenerates
+# after an intended change).  Exit-code contract pinned at 0 clean /
+# 1 findings / 2 crash so this composes with the chaos and compression
+# stages below.
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze diff --simulate 8
+JAX_PLATFORMS=cpu python -m pytest tests/test_schedule_audit.py -q \
+    -m schedule_smoke -p no:cacheprovider
+
 # compile-ahead sweep-engine smoke (bench/schedule.py is covered by the
 # lint pass above; this exercises the pipelined path end-to-end on the
 # simulated mesh — 2-op mini-sweep, compile accounting, manifest)

@@ -59,6 +59,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "schedule_smoke: α–β schedule-audit smoke — dependency-graph "
+        "fixtures + overlap/diff gates (tier-1; also invoked standalone "
+        "by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )

@@ -361,6 +361,73 @@ def test_lint_host_sync_in_finally_block():
     assert [f.rule for f in findings] == ["host-sync-in-timed-region"]
 
 
+WALLCLOCK_TIMER_FIXTURE = textwrap.dedent("""
+    import time
+    from dlbb_tpu.utils.metrics import Timer
+
+    def bench(fn, x):
+        with Timer() as t:
+            y = fn(x)
+            started = time.time()
+        return t.elapsed, y, started
+""")
+
+
+def test_lint_wallclock_in_timer_block():
+    """time.time() inside a Timer block is non-monotonic measurement
+    corruption — and unlike host syncs it gets NO bracketing exemption
+    (here it IS the final statement and still fires)."""
+    findings, _ = lint_source(WALLCLOCK_TIMER_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["wallclock-in-timed-region"]
+    assert "time.time()" in findings[0].message
+    fixed = WALLCLOCK_TIMER_FIXTURE.replace(
+        "started = time.time()", "started = time.perf_counter()"
+    )
+    assert lint_source(fixed, "fixture.py")[0] == []
+
+
+def test_lint_wallclock_in_perf_counter_region():
+    src = textwrap.dedent("""
+        import time
+        from datetime import datetime
+
+        def bench(fn, x):
+            t0 = time.perf_counter()
+            y = fn(x)
+            stamp = datetime.now()
+            elapsed = time.perf_counter() - t0
+            return elapsed, y, stamp
+    """)
+    findings, _ = lint_source(src, "fixture.py")
+    assert [f.rule for f in findings] == ["wallclock-in-timed-region"]
+    assert "datetime.now()" in findings[0].message
+    # a timestamp OUTSIDE the region is the sanctioned pattern (what
+    # runner.py does for the manifest)
+    moved = textwrap.dedent("""
+        import time
+        from datetime import datetime
+
+        def bench(fn, x):
+            t0 = time.perf_counter()
+            y = fn(x)
+            elapsed = time.perf_counter() - t0
+            stamp = datetime.now()
+            return elapsed, y, stamp
+    """)
+    assert lint_source(moved, "fixture.py")[0] == []
+
+
+def test_lint_wallclock_suppression():
+    src = WALLCLOCK_TIMER_FIXTURE.replace(
+        "started = time.time()",
+        "started = time.time()  "
+        "# comm-lint: disable=wallclock-in-timed-region",
+    )
+    findings, suppressed = lint_source(src, "fixture.py")
+    assert findings == []
+    assert suppressed == 1
+
+
 SET_ITER_FIXTURE = textwrap.dedent("""
     NAMES_A = ("b", "a")
     NAMES_B = ("c",)
