@@ -29,6 +29,17 @@ distributed benchmark repo cares about and generic linters do not:
   ``time.perf_counter()`` only (wall-clock *timestamps* belong outside
   the region).  Unlike host syncs there is no bracketing exemption: a
   wall-clock read is wrong anywhere inside the region.
+- ``profiler-in-timed-region``: a profiler/tracing call —
+  ``jax.profiler.*`` (``trace``, ``start_trace``, ``TraceAnnotation``,
+  ``StepTraceAnnotation``), the ``utils/profiling.py`` wrappers
+  (``maybe_trace`` / ``annotate`` / ``step_annotation``), or the obs
+  device capture (``obs.capture.capture_device_trace``) — inside a timed
+  region.  Profiler instrumentation perturbs the region it observes
+  (xplane capture serialises device work and burns host cycles), so
+  device traces must come from DEDICATED profile reps outside every
+  timed region (``docs/observability.md``); no bracketing exemption.
+  The sanctioned API homes (``utils/profiling.py``, ``obs/capture.py``)
+  are exempt, like ``utils/timing.py`` is for host syncs.
 - ``non-atomic-artifact-write``: a bare ``json.dump(...)`` (in-place
   write of the destination file) or ``*.write_text(json.dumps(...))``
   outside the sanctioned atomic helper (``utils/config.py``:
@@ -65,6 +76,7 @@ from dlbb_tpu.analysis.findings import (
 LINT_RULES = (
     "host-sync-in-timed-region",
     "wallclock-in-timed-region",
+    "profiler-in-timed-region",
     "missing-donation",
     "jit-in-loop",
     "unsorted-set-iteration",
@@ -73,6 +85,10 @@ LINT_RULES = (
 
 # Files whose whole purpose is host synchronisation around measurement.
 TIMING_API_FILES = ("utils/timing.py",)
+# The sanctioned profiler/capture API homes: the only files allowed to
+# bracket a profiler session with a wall timer (they report the capture's
+# own cost, never a published benchmark number).
+PROFILER_API_FILES = ("utils/profiling.py", "obs/capture.py")
 # The one sanctioned in-place writer: the atomic helper itself (its
 # json.dump-to-tmp is the mechanism every other writer must go through).
 ATOMIC_API_FILES = ("utils/config.py",)
@@ -92,6 +108,18 @@ _WALLCLOCK_NAMES = {
     "datetime.datetime.now", "datetime.datetime.utcnow",
     "date.today", "datetime.date.today",
 }
+# profiler entry points that must never run inside a timed region: the
+# wrapper API (utils/profiling.py + obs/capture.py) by short name, plus
+# anything reached through a `...profiler...` attribute chain
+# (jax.profiler.trace / start_trace / TraceAnnotation / ...)
+_PROFILER_CALL_NAMES = {
+    "maybe_trace", "annotate", "step_annotation", "capture_device_trace",
+}
+
+
+def _is_profiler_call(name: str) -> bool:
+    short = name.rsplit(".", 1)[-1]
+    return short in _PROFILER_CALL_NAMES or "profiler" in name
 
 
 # ---------------------------------------------------------------------------
@@ -192,6 +220,15 @@ def _wallclock_calls(stmt: ast.stmt) -> Iterable[tuple[ast.Call, str]]:
             yield node, f"{_call_name(node)}()"
 
 
+def _profiler_calls(stmt: ast.stmt) -> Iterable[tuple[ast.Call, str]]:
+    """(call, description) for every profiler/tracing call inside
+    ``stmt``."""
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Call) and _is_profiler_call(
+                _call_name(node)):
+            yield node, f"{_call_name(node)}()"
+
+
 # ---------------------------------------------------------------------------
 # rule implementations
 # ---------------------------------------------------------------------------
@@ -209,7 +246,8 @@ def _timed_with_blocks(tree: ast.AST) -> Iterable[ast.With]:
                 break
 
 
-def _check_timed_with(node: ast.With, path: str, findings: list[Finding]):
+def _check_timed_with(node: ast.With, path: str, findings: list[Finding],
+                      check_profiler: bool = True):
     last = node.body[-1]
     for stmt in node.body:
         for call, desc in _sync_calls(stmt):
@@ -249,10 +287,32 @@ def _check_timed_with(node: ast.With, path: str, findings: list[Finding]):
                 details={"clock": desc, "region": f"with Timer() at line "
                                                   f"{node.lineno}"},
             ))
+        if not check_profiler:
+            continue
+        # like the wall clock, no bracketing exemption: a profiler call
+        # perturbs the region wherever it sits
+        for call, desc in _profiler_calls(stmt):
+            findings.append(Finding(
+                pass_name="lint",
+                rule="profiler-in-timed-region",
+                severity=SEVERITY_ERROR,
+                target=path,
+                message=(
+                    f"{desc} inside a Timer block starts/annotates a "
+                    "profiler session in the measured region — capture "
+                    "overhead lands in the published number; trace on "
+                    "DEDICATED profile reps outside the timed region "
+                    "(dlbb_tpu.obs.capture, docs/observability.md)"
+                ),
+                location=f"{path}:{call.lineno}",
+                details={"call": desc, "region": f"with Timer() at line "
+                                                 f"{node.lineno}"},
+            ))
 
 
 def _check_perf_counter_regions(tree: ast.AST, path: str,
-                                findings: list[Finding]):
+                                findings: list[Finding],
+                                check_profiler: bool = True):
     """Statements strictly between ``t = time.perf_counter()`` and the
     statement consuming ``perf_counter() - t`` are a timed region."""
     for scope in ast.walk(tree):
@@ -323,6 +383,29 @@ def _check_perf_counter_regions(tree: ast.AST, path: str,
                                 ),
                                 location=f"{path}:{call.lineno}",
                                 details={"clock": desc,
+                                         "region": f"perf_counter span "
+                                                   f"'{var}'"},
+                            ))
+                        if not check_profiler:
+                            continue
+                        for call, desc in _profiler_calls(mid):
+                            findings.append(Finding(
+                                pass_name="lint",
+                                rule="profiler-in-timed-region",
+                                severity=SEVERITY_ERROR,
+                                target=path,
+                                message=(
+                                    f"{desc} between "
+                                    f"{var} = time.perf_counter() and its "
+                                    "delta runs a profiler session inside "
+                                    "the measured region — capture "
+                                    "overhead lands in the published "
+                                    "number; move the capture to a "
+                                    "dedicated profile rep outside the "
+                                    "region (dlbb_tpu.obs.capture)"
+                                ),
+                                location=f"{path}:{call.lineno}",
+                                details={"call": desc,
                                          "region": f"perf_counter span "
                                                    f"'{var}'"},
                             ))
@@ -500,9 +583,12 @@ def lint_source(source: str, path: str) -> tuple[list[Finding], int]:
     findings: list[Finding] = []
     norm = path.replace("\\", "/")
     if not norm.endswith(TIMING_API_FILES):
+        check_prof = not norm.endswith(PROFILER_API_FILES)
         for block in _timed_with_blocks(tree):
-            _check_timed_with(block, path, findings)
-        _check_perf_counter_regions(tree, path, findings)
+            _check_timed_with(block, path, findings,
+                              check_profiler=check_prof)
+        _check_perf_counter_regions(tree, path, findings,
+                                    check_profiler=check_prof)
     _check_donation(tree, path, findings)
     _check_jit_in_loop(tree, path, findings)
     _check_set_iteration(tree, path, findings)

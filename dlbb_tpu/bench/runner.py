@@ -49,6 +49,9 @@ from dlbb_tpu.comm.ops import (
     payload_cache_key,
 )
 from dlbb_tpu.comm.variants import Variant, get_variant
+from dlbb_tpu.obs import capture as obs_capture
+from dlbb_tpu.obs import spans
+from dlbb_tpu.obs.export import MetricsRegistry, sweep_metrics
 from dlbb_tpu.resilience import inject
 from dlbb_tpu.resilience.errors import (
     CorruptStats,
@@ -164,6 +167,14 @@ class Sweep1D:
     retry_backoff_seconds: float = 0.05
     # append-only crash-safe sweep_journal.jsonl next to the artifacts
     journal: bool = True
+    # --- observability knobs (docs/observability.md) ---------------------
+    # host-side span trace (Chrome trace-event JSON, Perfetto-loadable):
+    # a file path, or None = DLBB_SPANS env (usually unset -> disabled)
+    span_trace: Optional[str] = None
+    # per-config jax.profiler device captures on DEDICATED profile reps
+    # excluded from the stats series and run outside the measurement
+    # gate; a directory, or None = DLBB_DEVICE_TRACE env
+    device_trace_dir: Optional[str] = None
 
     kind: str = "1d"
 
@@ -198,6 +209,9 @@ class Sweep3D:
     max_retries: int = 2
     retry_backoff_seconds: float = 0.05
     journal: bool = True
+    # observability knobs — see Sweep1D / docs/observability.md
+    span_trace: Optional[str] = None
+    device_trace_dir: Optional[str] = None
 
     kind: str = "3d"
 
@@ -397,13 +411,22 @@ def run_sweep(
     if fault_spec is None and inject.active() is None:
         fault_spec = os.environ.get(inject.ENV_VAR, "").strip() or None
 
+    # span tracing (docs/observability.md): scoped to the sweep when a
+    # path is configured; a tracer an embedding harness (the CLI
+    # --span-trace wrapper, a test) already opened WINS and collects this
+    # sweep's spans — the tracing() scope is then a pure pass-through
+    span_path = sweep.span_trace or spans.default_span_path()
     # everything from here — planning included — runs with the persistent
     # compilation cache scoped to this sweep; the finally guarantees no
     # later non-sweep compile ever sees it (see
     # schedule.deactivate_compilation_cache)
     cache_dir = schedule.configure_compilation_cache(sweep.compile_cache)
     try:
-        with inject.plan_scope(fault_spec), PreemptionGuard() as guard:
+        with spans.tracing(span_path,
+                           meta={"kind": sweep.kind,
+                                 "implementation": impl,
+                                 "variant": variant.name}), \
+                inject.plan_scope(fault_spec), PreemptionGuard() as guard:
             return _run_sweep_configured(
                 sweep, variant, impl, out_dir, written, sysinfo, n_avail,
                 devices, mode, cache_dir, t_sweep0, verbose, guard,
@@ -490,6 +513,10 @@ def _run_sweep_configured(
         # coordinator's — records the run; per-host journals on a shared
         # filesystem would interleave duplicate lines
         enabled=sweep.journal and jax.process_index() == 0,
+        # every journal event doubles as a span-trace instant (no-op
+        # with no tracer active), so the trace and the fsync'd journal
+        # tell the same story — docs/observability.md
+        sink=spans.journal_sink,
     )
     # topology fingerprint (ROADMAP item 5 standing chore): which fabric
     # this sweep actually measured, journaled + manifested — a degraded
@@ -503,96 +530,115 @@ def _run_sweep_configured(
     # ---- planning pass -------------------------------------------------
     plan: list[_Planned] = []
     units: "dict[tuple, schedule.WorkUnit]" = {}
+    # per-sweep metrics registry (dlbb_tpu.obs.export): the config-outcome
+    # counters below are registry-backed, so the manifest's `configs`
+    # section and the metrics.prom textfile export come from one source
+    metrics = MetricsRegistry()
     # every counter counts CONFIGS (a skipped rank count skips one whole
     # grid of them), so planned+skipped+resumed+failed adds up
     # (resume_invalid configs re-run, so they also land in
     # measured/failed — the counter is informational)
     grid_size = sum(1 for _ in _iter_configs(sweep))
-    counts = {"resumed": 0, "resume_invalid": 0, "skipped_mem": 0,
-              "skipped_ranks": 0, "measured": 0, "failed": 0}
+    counts = metrics.labeled_counter(
+        "sweep_configs", "outcome",
+        initial=("resumed", "resume_invalid", "skipped_mem",
+                 "skipped_ranks", "measured", "failed"),
+        help="sweep configs by lifecycle outcome",
+    )
     quarantined: list[dict[str, Any]] = []
     retries_total = 0
     abandoned_measurements = 0
     preempted = False
-    for num_ranks in sweep.rank_counts:
-        if num_ranks > n_avail:
-            counts["skipped_ranks"] += grid_size
-            journal.event("rank-skip", num_ranks=num_ranks,
-                          reason=f"{num_ranks} ranks > {n_avail} devices")
-            if verbose:
-                print(
-                    f"[skip] {num_ranks} ranks > {n_avail} devices available"
-                )
-            continue
-        try:
-            spec = variant.mesh_spec(num_ranks)
-            mesh = get_mesh(spec, devices=devices)
-        except ValueError as e:
-            # e.g. fixed-shape variant (2x2x2) asked for an incompatible rank
-            # count — skip this rank count, keep sweeping (parity with the
-            # reference's per-config error-skip, collectives/1d/openmpi.py:253)
-            counts["skipped_ranks"] += grid_size
-            journal.event("rank-skip", num_ranks=num_ranks, reason=str(e))
-            if verbose:
-                print(f"[skip] ranks={num_ranks}: {e}")
-            continue
-        axes = spec.axis_names
-        for config in _iter_configs(sweep):
-            fname = _result_filename(sweep, impl, num_ranks, config)
-            # per-config containment covers the WHOLE planning of a config
-            # (mem estimate included — it resolves the op name too): e.g.
-            # an unknown op skips that config and keeps sweeping, exactly
-            # like a measurement-time failure
-            try:
-                if sweep.max_global_bytes is not None:
-                    est = _estimate_global_bytes(sweep, config, num_ranks)
-                    if est > sweep.max_global_bytes:
-                        counts["skipped_mem"] += 1
-                        journal.event("skipped", config=fname,
-                                      reason="memory-cap",
-                                      estimated_bytes=est)
-                        if verbose:
-                            print(
-                                f"[skip-mem] {config['operation']} ranks="
-                                f"{num_ranks} {config}: ~{est / 2**30:.1f} "
-                                "GiB > cap "
-                                f"{sweep.max_global_bytes / 2**30:.1f} GiB"
-                            )
-                        continue
-                if sweep.resume:
-                    existing = out_dir / fname
-                    ok, why = _resume_ok(existing)
-                    if ok:
-                        counts["resumed"] += 1
-                        journal.event("resume-valid", config=fname)
-                        if verbose:
-                            print(f"  [resume-skip] {existing.name}")
-                        written.append(existing)
-                        continue
-                    if why != "missing":
-                        # died-mid-write / corrupt artifact: NEVER trust
-                        # it — re-measure (atomic overwrite) with a
-                        # durable record of why
-                        counts["resume_invalid"] += 1
-                        journal.event("resume-invalid", config=fname,
-                                      reason=why)
-                        if verbose:
-                            print(f"  [resume-INVALID] {existing.name}: "
-                                  f"{why} — re-measuring")
-                plan.append(_plan_config(
-                    sweep, variant, mesh, axes, num_ranks, config, units,
-                    mode,
-                ))
-                journal.event("planned", config=fname)
-            except Exception as e:  # noqa: BLE001 — per-config containment
-                counts["failed"] += 1
-                quarantined.append({"config": fname, "phase": "planning",
-                                    "retries": 0, **exception_chain(e)})
-                journal.event("failed", config=fname, phase="planning",
-                              error=str(e))
+    with spans.span("plan", cat="sweep", grid_configs=grid_size,
+                    rank_counts=str(tuple(sweep.rank_counts))):
+        for num_ranks in sweep.rank_counts:
+            if num_ranks > n_avail:
+                counts["skipped_ranks"] += grid_size
+                journal.event("rank-skip", num_ranks=num_ranks,
+                              reason=f"{num_ranks} ranks > {n_avail} devices")
                 if verbose:
-                    print(f"[error] {impl} {config}: planning failed: {e}")
+                    print(
+                        f"[skip] {num_ranks} ranks > {n_avail} devices "
+                        "available"
+                    )
                 continue
+            try:
+                spec = variant.mesh_spec(num_ranks)
+                mesh = get_mesh(spec, devices=devices)
+            except ValueError as e:
+                # e.g. fixed-shape variant (2x2x2) asked for an incompatible
+                # rank count — skip this rank count, keep sweeping (parity
+                # with the reference's per-config error-skip,
+                # collectives/1d/openmpi.py:253)
+                counts["skipped_ranks"] += grid_size
+                journal.event("rank-skip", num_ranks=num_ranks,
+                              reason=str(e))
+                if verbose:
+                    print(f"[skip] ranks={num_ranks}: {e}")
+                continue
+            axes = spec.axis_names
+            for config in _iter_configs(sweep):
+                fname = _result_filename(sweep, impl, num_ranks, config)
+                # per-config containment covers the WHOLE planning of a
+                # config (mem estimate included — it resolves the op name
+                # too): e.g. an unknown op skips that config and keeps
+                # sweeping, exactly like a measurement-time failure
+                try:
+                    if sweep.max_global_bytes is not None:
+                        est = _estimate_global_bytes(sweep, config,
+                                                     num_ranks)
+                        if est > sweep.max_global_bytes:
+                            counts["skipped_mem"] += 1
+                            journal.event("skipped", config=fname,
+                                          reason="memory-cap",
+                                          estimated_bytes=est)
+                            if verbose:
+                                print(
+                                    f"[skip-mem] {config['operation']} "
+                                    f"ranks={num_ranks} {config}: "
+                                    f"~{est / 2**30:.1f} GiB > cap "
+                                    f"{sweep.max_global_bytes / 2**30:.1f}"
+                                    " GiB"
+                                )
+                            continue
+                    if sweep.resume:
+                        existing = out_dir / fname
+                        ok, why = _resume_ok(existing)
+                        if ok:
+                            counts["resumed"] += 1
+                            journal.event("resume-valid", config=fname)
+                            if verbose:
+                                print(f"  [resume-skip] {existing.name}")
+                            written.append(existing)
+                            continue
+                        if why != "missing":
+                            # died-mid-write / corrupt artifact: NEVER
+                            # trust it — re-measure (atomic overwrite)
+                            # with a durable record of why
+                            counts["resume_invalid"] += 1
+                            journal.event("resume-invalid", config=fname,
+                                          reason=why)
+                            if verbose:
+                                print(f"  [resume-INVALID] "
+                                      f"{existing.name}: {why} — "
+                                      "re-measuring")
+                    plan.append(_plan_config(
+                        sweep, variant, mesh, axes, num_ranks, config,
+                        units, mode,
+                    ))
+                    journal.event("planned", config=fname)
+                except Exception as e:  # noqa: BLE001 — containment
+                    counts["failed"] += 1
+                    quarantined.append({"config": fname,
+                                        "phase": "planning",
+                                        "retries": 0,
+                                        **exception_chain(e)})
+                    journal.event("failed", config=fname, phase="planning",
+                                  error=str(e))
+                    if verbose:
+                        print(f"[error] {impl} {config}: planning "
+                              f"failed: {e}")
+                    continue
 
     # ---- measurement pass, compile-ahead overlapped --------------------
     # the gate keeps background compiles out of timed regions (see
@@ -609,6 +655,12 @@ def _run_sweep_configured(
         measure_gate=measure_gate,
     )
     payloads = schedule.PayloadCache()
+    # gated device-trace capture (docs/observability.md): when a capture
+    # directory is configured, every measured config runs ONE dedicated
+    # profile rep after its timed region, outside the measurement gate —
+    # the rep never joins the stats series
+    capture_dir = (sweep.device_trace_dir
+                   or obs_capture.default_capture_dir())
     deadline = _resolve_deadline(sweep)
     if deadline is not None and jax.process_count() > 1:
         # a per-host abandon cannot be coordinated through a hung SPMD
@@ -644,7 +696,8 @@ def _run_sweep_configured(
                           "the grid")
                 break
             try:
-                unit = scheduler.get(entry.unit, deadline=deadline)
+                with spans.span("compile-wait", cat="sweep", config=fname):
+                    unit = scheduler.get(entry.unit, deadline=deadline)
             except DeadlineExceeded as e:
                 counts["failed"] += 1
                 quarantined.append({
@@ -675,15 +728,19 @@ def _run_sweep_configured(
             attempt = 0
             for attempt in range(attempts):
                 try:
-                    path = _call_with_deadline(
-                        lambda cancel: _run_one(
-                            sweep, variant, impl, entry, out_dir, sysinfo,
-                            verbose, mode=mode, payloads=payloads,
-                            measure_gate=measure_gate, retries=attempt,
-                            unit=unit, cancel=cancel,
-                        ),
-                        deadline, unit.label, measure_gate,
-                    )
+                    with spans.span(fname, cat="config",
+                                    unit=unit.label, attempt=attempt):
+                        path = _call_with_deadline(
+                            lambda cancel: _run_one(
+                                sweep, variant, impl, entry, out_dir,
+                                sysinfo, verbose, mode=mode,
+                                payloads=payloads,
+                                measure_gate=measure_gate, retries=attempt,
+                                unit=unit, cancel=cancel,
+                                capture_dir=capture_dir, metrics=metrics,
+                            ),
+                            deadline, unit.label, measure_gate,
+                        )
                     written.append(path)
                     counts["measured"] += 1
                     retries_total += attempt
@@ -737,7 +794,8 @@ def _run_sweep_configured(
     if plan or counts["resumed"]:
         unit_list = list(units.values())
         compiled = [u for u in unit_list if u.ready.is_set() and not u.error]
-        schedule.write_sweep_manifest(out_dir, {
+        tracer = spans.active()
+        manifest_payload = {
             "kind": sweep.kind,
             "implementation": impl,
             "variant": variant.name,
@@ -773,6 +831,15 @@ def _run_sweep_configured(
             },
             "configs": dict(counts),
             "payload_cache": payloads.stats(),
+            # where this sweep's wall clock went (docs/observability.md):
+            # the span-trace path when tracing was on, and how many
+            # dedicated profile reps were captured (all outside the
+            # stats series by construction)
+            "observability": {
+                "span_trace": str(tracer.path) if tracer else None,
+                "device_trace_dir": capture_dir,
+                "device_captures": int(metrics.get("sweep_device_captures")),
+            },
             "resilience": {
                 "fault_plan": getattr(inject.active(), "spec", None),
                 "unit_deadline_seconds": deadline,
@@ -790,7 +857,18 @@ def _run_sweep_configured(
                 },
             },
             "timestamp": time.time(),
-        })
+        }
+        schedule.write_sweep_manifest(out_dir, manifest_payload)
+        # the Prometheus textfile export next to the manifest: the same
+        # registry that backed the config counters, plus the manifest's
+        # aggregate gauges (obs/export.sweep_metrics)
+        sweep_metrics(manifest_payload, metrics).write_textfile(
+            out_dir / "metrics.prom"
+        )
+        if tracer is not None:
+            # checkpoint the trace now (stop() rewrites it at scope exit):
+            # a crash after this point still leaves a loadable timeline
+            tracer.finish()
     journal.event("sweep-end", preempted=preempted,
                   measured=counts["measured"], failed=counts["failed"])
     journal.close()
@@ -900,6 +978,8 @@ def _run_one(
     measure_gate=None, retries: int = 0,
     unit: Optional[schedule.WorkUnit] = None,
     cancel: Optional[threading.Event] = None,
+    capture_dir: Optional[str] = None,
+    metrics: Optional[MetricsRegistry] = None,
 ) -> Path:
     mesh, axes = planned.mesh, planned.axes
     num_ranks, config = planned.num_ranks, planned.config
@@ -923,8 +1003,9 @@ def _run_one(
 
     # chained timing DONATES its carry, so a cached payload would come back
     # deleted — only per-iter configs share payloads
-    x = (build_payload() if mode == "chained"
-         else payloads.get(planned.payload_key, build_payload))
+    with spans.span("payload", cat="payload", label=unit.label):
+        x = (build_payload() if mode == "chained"
+             else payloads.get(planned.payload_key, build_payload))
     fn = unit.fn
     chain = op.make_chain(num_ranks) if op.make_chain is not None else None
 
@@ -941,9 +1022,13 @@ def _run_one(
     # holding the gate keeps the compile-ahead worker out of the timed
     # region — background compilation contends for the host cores the
     # measured program runs on (measurement-honesty invariant; see
-    # schedule.CompileAheadScheduler)
+    # schedule.CompileAheadScheduler).  The span brackets the region from
+    # the OUTSIDE (its clock reads happen before the gate is taken and
+    # after it is released).
     try:
-        with measure_gate if measure_gate is not None else _NULL_GATE:
+        with spans.span("measure", cat="measure", label=unit.label,
+                        mode=mode), \
+                (measure_gate if measure_gate is not None else _NULL_GATE):
             local, timing_meta = time_collective(
                 fn, x,
                 chain=chain,
@@ -984,6 +1069,27 @@ def _run_one(
             f"{unit.label}: {why} — refusing to write the artifact"
         )
 
+    # gated device-trace capture (docs/observability.md): one DEDICATED
+    # profile rep on a FRESH payload, after the timed region and outside
+    # the measurement gate — its timing never joins `timings`, and a
+    # capture failure never fails the config (error lands in the
+    # metadata instead)
+    capture_meta = None
+    if capture_dir:
+        fname_cap = _result_filename(sweep, impl, num_ranks, config)
+        with spans.span("device-capture", cat="capture", label=unit.label):
+            capture_meta = obs_capture.capture_device_trace(
+                fn, build_payload, capture_dir,
+                label=fname_cap.rsplit(".", 1)[0],
+            )
+        # only SUCCESSFUL captures count — a contained failure (profiler
+        # held elsewhere) left no trace on disk and must not inflate the
+        # manifest's device_captures
+        if metrics is not None and "error" not in capture_meta:
+            metrics.inc("sweep_device_captures",
+                        help="dedicated profile reps captured "
+                             "(excluded from stats)")
+
     # the first config that WRITES an artifact reports the compile its
     # work unit paid for (see WorkUnit.compile_reported); later sharers
     # paid nothing (in-process dedup) and report a cache hit
@@ -1023,6 +1129,10 @@ def _run_one(
         "payload_bytes_per_rank": num_elements * elem_bytes,
         "timestamp": time.time(),
         "system_info": sysinfo,
+        # device-capture metadata (trace path + the excluded_from_stats
+        # marker); absent on untraced runs — every stats field above is
+        # identical either way (the obs_smoke equivalence gate)
+        **({"device_trace": capture_meta} if capture_meta else {}),
     }
 
     if sweep.kind == "1d":
@@ -1043,7 +1153,8 @@ def _run_one(
         raise DeadlineExceeded(unit.label, 0.0, phase="measure (zombie "
                                "write suppressed after abandonment)")
     fname = _result_filename(sweep, impl, num_ranks, config)
-    path = save_json(result, out_dir / fname)
+    with spans.span("write", cat="io", file=fname):
+        path = save_json(result, out_dir / fname)
     unit.compile_reported = True
     if verbose:
         # the same median the stats pipeline publishes

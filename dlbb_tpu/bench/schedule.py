@@ -58,6 +58,7 @@ from typing import Any, Callable, Iterable, Optional, Sequence
 import jax
 
 from dlbb_tpu.comm.ops import CollectiveOp, payload_aval
+from dlbb_tpu.obs import spans
 from dlbb_tpu.resilience import inject
 from dlbb_tpu.resilience.errors import DeadlineExceeded, InjectedFault
 from dlbb_tpu.utils.timing import build_chained_loop, chained_chunk_size
@@ -339,7 +340,12 @@ def _compile_unit(unit: WorkUnit, locked: bool = True) -> None:
             # models a wedged XLA compile: the watchdog (deadline-aware
             # get()) must abandon + quarantine without blocking the drain
             time.sleep(inject.param("hang_seconds"))
-        with _COMPILE_LOCK if locked else contextlib.nullcontext():
+        # the span wraps lock wait + compile (docs/observability.md) —
+        # its clock reads sit OUTSIDE the compile_seconds bracket, so
+        # tracing never inflates the compile accounting
+        with spans.span("compile", cat="compile", label=unit.label,
+                        chained=unit.chained), \
+                (_COMPILE_LOCK if locked else contextlib.nullcontext()):
             hits0, misses0 = CACHE_EVENTS.snapshot()
             t0 = time.perf_counter()
             unit.fn, unit.executable = unit.build()

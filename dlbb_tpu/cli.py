@@ -64,6 +64,12 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--no-journal", action="store_true",
                    help="disable the append-only sweep_journal.jsonl "
                         "(crash audit trail; on by default)")
+    p.add_argument("--device-trace", default=None, metavar="DIR",
+                   dest="device_trace",
+                   help="capture a jax.profiler device trace per config on "
+                        "a DEDICATED profile rep (excluded from the stats "
+                        "series) under DIR; DLBB_DEVICE_TRACE env is the "
+                        "default (docs/observability.md)")
     _add_trace(p)
 
 
@@ -71,6 +77,12 @@ def _add_trace(p: argparse.ArgumentParser) -> None:
     p.add_argument("--trace", default=None, metavar="DIR",
                    help="write an XLA profiler trace (xplane) to DIR; "
                         "DLBB_TRACE_DIR env is the default")
+    p.add_argument("--span-trace", default=None, metavar="FILE",
+                   dest="span_trace",
+                   help="write a host-side span trace (Chrome trace-event "
+                        "JSON, Perfetto-loadable) of the whole run to FILE; "
+                        "DLBB_SPANS env is the default "
+                        "(docs/observability.md)")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -171,6 +183,49 @@ def build_parser() -> argparse.ArgumentParser:
                          "auto from the backend — see "
                          "analysis/costmodel.py)")
 
+    ob = sub.add_parser(
+        "obs",
+        help="runtime observability: journal->trace reconstruction "
+             "(trace), the predicted-vs-measured cost-model calibration "
+             "report (calibrate), and the calibration regression gate "
+             "(diff) — exit codes pinned 0 clean / 1 findings / 2 crash "
+             "(docs/observability.md)",
+    )
+    ob.add_argument("which", choices=("trace", "calibrate", "diff"),
+                    help="trace = rebuild a Perfetto timeline from a "
+                         "sweep's journal; calibrate = measure every "
+                         "committed schedule-baseline target and report "
+                         "signed predicted-vs-measured error; diff = fail "
+                         "when the model error regressed past the "
+                         "committed calibration baseline")
+    ob.add_argument("--journal", default=None, metavar="DIR",
+                    help="sweep output directory holding "
+                         "sweep_journal.jsonl (obs trace)")
+    ob.add_argument("--output", default=None,
+                    help="output path (trace JSON) or report directory "
+                         "(calibrate/diff; default results/obs)")
+    ob.add_argument("--baselines", default=None, metavar="DIR",
+                    help="schedule-baseline directory to calibrate "
+                         "against (default: stats/analysis/baselines)")
+    ob.add_argument("--calibration", default=None, metavar="DIR",
+                    help="committed calibration baseline for diff "
+                         "(default: stats/analysis/calibration)")
+    ob.add_argument("--report", default=None, metavar="JSON",
+                    help="diff an existing calibration report instead of "
+                         "re-measuring")
+    ob.add_argument("--simulate", type=int, default=0, metavar="N")
+    ob.add_argument("--tier", default=None, metavar="TIER",
+                    help="cost-model tier (default: auto from the "
+                         "backend; must match the committed baselines)")
+    ob.add_argument("--reps", type=int, default=30,
+                    help="timed reps per target (default 30)")
+    ob.add_argument("--warmup", type=int, default=5)
+    ob.add_argument("--targets", nargs="+", default=None,
+                    help="substring filter on baseline target names "
+                         "(calibrate/diff subset runs)")
+    ob.add_argument("--strict-warnings", action="store_true",
+                    help="exit nonzero on warnings too")
+
     ch = sub.add_parser(
         "chaos",
         help="chaos gate: mini-sweep/mini-train under each injected fault "
@@ -246,12 +301,19 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd in ("bench1d", "bench3d", "e2e", "train"):
         # stats subcommands are pure numpy file processing — no backend,
         # no profiler, and no jax import even when DLBB_TRACE_DIR is set
+        from dlbb_tpu.obs import spans
         from dlbb_tpu.utils.profiling import maybe_trace
 
-        with maybe_trace(getattr(args, "trace", None)) as trace_dir:
+        span_path = getattr(args, "span_trace", None) \
+            or spans.default_span_path()
+        with spans.tracing(span_path, meta={"cmd": args.cmd}) as tracer, \
+                maybe_trace(getattr(args, "trace", None)) as trace_dir:
             rc = _dispatch(args)
         if trace_dir:
             print(f"[trace] xplane trace written to {trace_dir}")
+        if tracer is not None:
+            print(f"[obs] span trace written to {tracer.path} "
+                  "(load in https://ui.perfetto.dev)")
         return rc
     return _dispatch(args)
 
@@ -304,6 +366,8 @@ def _dispatch(args) -> int:
             unit_deadline_seconds=args.unit_deadline,
             max_retries=args.max_retries,
             journal=not args.no_journal,
+            span_trace=args.span_trace,
+            device_trace_dir=args.device_trace,
         )
         files = run_sweep(sweep)
         # resume mode counts pre-existing artifacts too — don't claim writes
@@ -333,6 +397,8 @@ def _dispatch(args) -> int:
             unit_deadline_seconds=args.unit_deadline,
             max_retries=args.max_retries,
             journal=not args.no_journal,
+            span_trace=args.span_trace,
+            device_trace_dir=args.device_trace,
         )
         files = run_sweep(sweep)
         print(f"{len(files)} result artifacts in {sweep.output_dir}")
@@ -460,6 +526,17 @@ def _dispatch(args) -> int:
             which=args.which, root=args.root, json_path=args.json,
             strict_warnings=args.strict_warnings,
             baselines=args.baselines, tier=args.tier,
+        )
+
+    if args.cmd == "obs":
+        from dlbb_tpu.obs import run_obs
+
+        return run_obs(
+            which=args.which, journal=args.journal, output=args.output,
+            baselines=args.baselines, calibration=args.calibration,
+            report=args.report, tier=args.tier, reps=args.reps,
+            warmup=args.warmup, targets=args.targets,
+            strict_warnings=args.strict_warnings,
         )
 
     if args.cmd == "chaos":

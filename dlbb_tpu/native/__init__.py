@@ -29,7 +29,7 @@ _lib: Any = None
 _tried = False
 
 SUMMARY_FIELDS = ("mean", "std", "min", "max", "median", "p95", "p99",
-                  "count")
+                  "p999", "count")
 
 
 def _build() -> bool:
@@ -72,6 +72,15 @@ def _load() -> Any:
     dbl_p = ctypes.POINTER(ctypes.c_double)
     lib.dlbb_summarize.argtypes = [dbl_p, ctypes.c_long, dbl_p]
     lib.dlbb_summarize.restype = ctypes.c_int
+    # v2 adds p999; a stale pre-v2 .so (built from an older checkout)
+    # lacks the symbol — summarize_native then computes p999 in numpy on
+    # top of the v1 result instead of failing the whole native path
+    try:
+        lib.dlbb_summarize2.argtypes = [dbl_p, ctypes.c_long, dbl_p]
+        lib.dlbb_summarize2.restype = ctypes.c_int
+        lib._dlbb_has_v2 = True
+    except AttributeError:
+        lib._dlbb_has_v2 = False
     lib.dlbb_load_imbalance.argtypes = [dbl_p, ctypes.c_long]
     lib.dlbb_load_imbalance.restype = ctypes.c_double
     lib.dlbb_row_means.argtypes = [dbl_p, ctypes.c_long, ctypes.c_long,
@@ -100,13 +109,26 @@ def summarize_native(values) -> Optional[dict[str, float]]:
     ptr, arr = _as_c_array(values)
     if arr.size == 0:
         return None
-    out = np.empty(8, dtype=np.float64)
-    rc = lib.dlbb_summarize(
-        ptr, arr.size, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-    )
-    if rc != 0:
-        return None
-    result = dict(zip(SUMMARY_FIELDS, (float(v) for v in out)))
+    if lib._dlbb_has_v2:
+        out = np.empty(9, dtype=np.float64)
+        rc = lib.dlbb_summarize2(
+            ptr, arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        )
+        if rc != 0:
+            return None
+        result = dict(zip(SUMMARY_FIELDS, (float(v) for v in out)))
+    else:
+        out = np.empty(8, dtype=np.float64)
+        rc = lib.dlbb_summarize(
+            ptr, arr.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        )
+        if rc != 0:
+            return None
+        v1_fields = tuple(f for f in SUMMARY_FIELDS if f != "p999")
+        result = dict(zip(v1_fields, (float(v) for v in out)))
+        result["p999"] = float(np.percentile(arr, 99.9))
     result["count"] = int(result["count"])
     return result
 

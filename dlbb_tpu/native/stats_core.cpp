@@ -32,9 +32,11 @@ double percentile_sorted(const std::vector<double>& s, double q) {
 
 extern "C" {
 
-// out[8] = mean, std, min, max, median, p95, p99, count
-// returns 0 on success, -1 on bad input
-int dlbb_summarize(const double* xs, long n, double* out) {
+// out[9] = mean, std, min, max, median, p95, p99, p999, count
+// v2 of dlbb_summarize: adds the p99.9 tail (serving-path metrics key on
+// it).  This is THE summary implementation; v1 below wraps it so the two
+// ABI entry points can never drift numerically.
+int dlbb_summarize2(const double* xs, long n, double* out) {
     if (xs == nullptr || out == nullptr || n <= 0) return -1;
     double sum = 0.0;
     for (long i = 0; i < n; ++i) sum += xs[i];
@@ -53,7 +55,20 @@ int dlbb_summarize(const double* xs, long n, double* out) {
     out[4] = percentile_sorted(s, 50.0);
     out[5] = percentile_sorted(s, 95.0);
     out[6] = percentile_sorted(s, 99.0);
-    out[7] = static_cast<double>(n);
+    out[7] = percentile_sorted(s, 99.9);
+    out[8] = static_cast<double>(n);
+    return 0;
+}
+
+// out[8] = mean, std, min, max, median, p95, p99, count
+// Legacy ABI (pre-p999 consumers); thin shim over the v2 core.
+int dlbb_summarize(const double* xs, long n, double* out) {
+    if (out == nullptr) return -1;
+    double tmp[9];
+    const int rc = dlbb_summarize2(xs, n, tmp);
+    if (rc != 0) return rc;
+    for (int i = 0; i < 7; ++i) out[i] = tmp[i];
+    out[7] = tmp[8];  // count (v1 has no p999 slot)
     return 0;
 }
 

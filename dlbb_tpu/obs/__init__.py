@@ -1,0 +1,161 @@
+"""Runtime observability subsystem (the runtime mirror of ``analysis/``).
+
+The static-analysis subsystem (comm-lint, the α–β schedule auditor)
+*predicts* behaviour; this package observes what actually ran and closes
+the loop:
+
+- :mod:`~dlbb_tpu.obs.spans` — thread-safe host-side span tracer emitting
+  Chrome trace-event JSON (Perfetto-loadable).  The sweep engine, the
+  train loop and the resilience journal all emit into it, so "where did
+  this 40-minute sweep's wall clock go" is one trace load away — and a
+  crashed sweep's timeline is reconstructable from either the trace or
+  the fsync'd journal (every journal event doubles as a trace instant
+  through the journal's pluggable sink).
+- :mod:`~dlbb_tpu.obs.capture` — gated per-config ``jax.profiler``
+  device-trace capture on DEDICATED profile reps that are excluded from
+  the stats series and run outside the measurement gate; the
+  ``profiler-in-timed-region`` comm-lint rule keeps any profiler call
+  out of timed regions, so tracing can never contaminate published
+  numbers.
+- :mod:`~dlbb_tpu.obs.calibration` — the predicted-vs-measured gate:
+  joins the committed α–β schedule baselines
+  (``stats/analysis/baselines/``) against real measurements of the SAME
+  lowered programs and reports signed relative error per target
+  (``cli obs calibrate``); ``cli obs diff`` fails CI when the model
+  error regresses past the committed calibration baseline — the
+  falsifiability loop ROADMAP item 2's autotuner needs.
+- :mod:`~dlbb_tpu.obs.export` — a small counters/gauges metrics registry
+  with labels that backs the sweep-manifest aggregates and a
+  Prometheus-textfile export (``metrics.prom`` next to the manifest).
+
+CLI: ``python -m dlbb_tpu.cli obs {trace,calibrate,diff}``.  Exit codes
+follow the pinned ``analysis.findings.EXIT_*`` contract: 0 clean /
+1 findings / 2 crash.  See ``docs/observability.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dlbb_tpu.analysis.findings import (
+    EXIT_CLEAN,
+    EXIT_CRASH,
+    EXIT_FINDINGS,
+    AnalysisReport,
+)
+
+
+def run_obs(
+    which: str,
+    journal: Optional[str] = None,
+    output: Optional[str] = None,
+    baselines: Optional[str] = None,
+    calibration: Optional[str] = None,
+    report: Optional[str] = None,
+    tier: Optional[str] = None,
+    reps: int = 30,
+    warmup: int = 5,
+    targets: Optional[list[str]] = None,
+    strict_warnings: bool = False,
+    verbose: bool = True,
+) -> int:
+    """CLI driver for the ``obs`` subcommands.  Same exit-code contract
+    as ``analysis.run_analysis``: any internal exception surfaces as
+    :data:`EXIT_CRASH`, never as an arbitrary code."""
+    try:
+        return _run_obs(
+            which=which, journal=journal, output=output,
+            baselines=baselines, calibration=calibration, report=report,
+            tier=tier, reps=reps, warmup=warmup, targets=targets,
+            strict_warnings=strict_warnings, verbose=verbose,
+        )
+    except Exception:  # noqa: BLE001 — the exit-code contract
+        import traceback
+
+        traceback.print_exc()
+        return EXIT_CRASH
+
+
+def _run_obs(
+    which: str,
+    journal: Optional[str],
+    output: Optional[str],
+    baselines: Optional[str],
+    calibration: Optional[str],
+    report: Optional[str],
+    tier: Optional[str],
+    reps: int,
+    warmup: int,
+    targets: Optional[list[str]],
+    strict_warnings: bool,
+    verbose: bool,
+) -> int:
+    from pathlib import Path
+
+    if which == "trace":
+        from dlbb_tpu.obs.spans import journal_to_trace
+
+        if not journal:
+            print("error: obs trace needs --journal DIR (a sweep output "
+                  "directory holding sweep_journal.jsonl)")
+            return EXIT_CRASH
+        out = Path(output) if output else Path(journal) / "sweep_trace.json"
+        path, n_events, torn = journal_to_trace(journal, out)
+        if verbose:
+            print(f"[obs] {n_events} journal event(s) -> {path}"
+                  + (f" ({torn} torn line(s) skipped)" if torn else ""))
+        return EXIT_CLEAN
+
+    from dlbb_tpu.obs import calibration as cal
+
+    if which == "calibrate":
+        out_dir = Path(output) if output else cal.DEFAULT_REPORT_DIR
+        rep = cal.run_calibration(
+            baselines_dir=Path(baselines) if baselines else None,
+            out_dir=out_dir, tier=tier, reps=reps, warmup=warmup,
+            target_filter=targets, verbose=verbose,
+        )
+        agg = rep["aggregate"]
+        if not rep["targets"]:
+            # zero measured targets is a FINDING (bad --targets filter,
+            # tier skew, too-small mesh), never a crash: the aggregate
+            # fields are None here, so don't try to format them
+            print(
+                f"[obs] calibration measured 0 targets "
+                f"({agg['targets_skipped']} skipped) — check --targets / "
+                "--tier / --simulate against the committed baselines"
+            )
+            return EXIT_FINDINGS
+        if verbose:
+            print(
+                f"[obs] calibration: {agg['targets_measured']} target(s) "
+                f"measured ({agg['targets_skipped']} skipped), median "
+                f"signed error {agg['median_signed_rel_error']:+.2f}x, "
+                f"geomean error factor {agg['geomean_error_factor']:.1f}x "
+                f"-> {out_dir / cal.REPORT_NAME}"
+            )
+        return EXIT_CLEAN
+
+    if which == "diff":
+        rep_obj = None
+        if report:
+            import json
+
+            rep_obj = json.loads(Path(report).read_text())
+        else:
+            out_dir = Path(output) if output else cal.DEFAULT_REPORT_DIR
+            rep_obj = cal.run_calibration(
+                baselines_dir=Path(baselines) if baselines else None,
+                out_dir=out_dir, tier=tier, reps=reps, warmup=warmup,
+                target_filter=targets, verbose=verbose,
+            )
+        base_dir = (Path(calibration) if calibration
+                    else cal.DEFAULT_CALIBRATION_DIR)
+        findings = cal.diff_calibration(rep_obj, base_dir)
+        result = AnalysisReport(findings=findings)
+        if verbose:
+            print(result.render_summary())
+        return result.exit_code(strict_warnings=strict_warnings)
+
+    print(f"error: unknown obs mode {which!r}")
+    return EXIT_CRASH

@@ -1,0 +1,94 @@
+"""Gated per-config device-trace capture (``jax.profiler`` xplane).
+
+The capture contract that keeps published numbers honest:
+
+- captures run on DEDICATED profile reps — separate invocations of the
+  work unit's program, never appended to the timing series the stats
+  pipeline summarises (a traced sweep's artifacts are byte-identical in
+  every stats field to an untraced run, asserted by the ``obs_smoke``
+  gate);
+- captures are scheduled strictly OUTSIDE the timed region and outside
+  the PR-3/PR-5 measurement gate — after ``time_collective`` has
+  returned and the gate has been released, so profiler overhead can
+  never contend with a measurement (and a background compile is free to
+  proceed during the capture: the capture is not a measurement);
+- the ``profiler-in-timed-region`` comm-lint rule
+  (``analysis/source_lint.py``) statically rejects any
+  ``jax.profiler``/capture call inside a ``Timer`` block or
+  ``perf_counter`` span anywhere in the repo, so the contract cannot rot
+  by accident.  This file is the sanctioned capture API and is exempt
+  (like ``utils/timing.py`` for host syncs).
+
+Capture failures are contained: a broken profiler (e.g. an outer
+``--trace`` session already holding the singleton profiler state) lands
+as an ``error`` field in the capture metadata, never as a failed config.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+CAPTURE_META_SCHEMA = "dlbb_device_capture_v1"
+
+ENV_VAR = "DLBB_DEVICE_TRACE"
+
+
+def default_capture_dir() -> Optional[str]:
+    """Env-switched default (``DLBB_DEVICE_TRACE=dir``), or None."""
+    import os
+
+    return os.environ.get(ENV_VAR) or None
+
+
+def _slug(label: str) -> str:
+    return re.sub(r"[^\w.+-]+", "_", label).strip("_") or "capture"
+
+
+def capture_device_trace(
+    fn: Callable,
+    payload_builder: Callable[[], Any],
+    trace_root: "str | Path",
+    label: str,
+    profile_reps: int = 1,
+) -> dict[str, Any]:
+    """Run ``profile_reps`` dedicated executions of ``fn`` on a freshly
+    built payload under ``jax.profiler.trace``, writing the xplane trace
+    to ``trace_root/<label>/``.  Returns capture metadata for the result
+    JSON / sweep manifest; the reps' timings are deliberately NOT
+    returned — profile reps never enter a stats series."""
+    import jax
+
+    trace_dir = Path(trace_root) / _slug(label)
+    meta: dict[str, Any] = {
+        "schema": CAPTURE_META_SCHEMA,
+        "label": label,
+        "trace_dir": str(trace_dir),
+        "profile_reps": int(profile_reps),
+        # the honesty marker consumers key on: these reps are outside
+        # the measurement series by construction
+        "excluded_from_stats": True,
+    }
+    t0 = time.perf_counter()
+    try:
+        # a fresh payload: the measured payload may be cached (shared
+        # with later configs) or donated (chained timing) — the capture
+        # must never consume either
+        x = payload_builder()
+        trace_dir.mkdir(parents=True, exist_ok=True)
+        with jax.profiler.trace(str(trace_dir)):
+            with jax.profiler.TraceAnnotation(f"profile_rep:{label}"):
+                for _ in range(max(1, int(profile_reps))):
+                    jax.block_until_ready(fn(x))
+    except Exception as e:  # noqa: BLE001 — capture must not fail a config
+        meta["error"] = f"{type(e).__name__}: {e}"
+    meta["wall_seconds"] = time.perf_counter() - t0
+    return meta
+
+
+def xplane_files(trace_root: "str | Path") -> list[Path]:
+    """The ``.xplane.pb`` files under a capture directory — what a
+    capture must have produced to count as successful."""
+    return sorted(Path(trace_root).rglob("*.xplane.pb"))

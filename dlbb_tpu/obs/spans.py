@@ -1,0 +1,341 @@
+"""Unified host-side span tracing (Chrome trace-event JSON).
+
+One process-wide :class:`SpanTracer` collects begin/end span pairs and
+instant events from every layer of the harness — sweep planning /
+compile / measure / write phases (``bench/schedule.py``,
+``bench/runner.py``), train-loop steps and checkpoint saves
+(``train/loop.py``), and every resilience-journal event (the journal's
+pluggable sink forwards each fsync'd line as a trace instant, so a
+crashed sweep's timeline is reconstructable from either artifact).  The
+output is the Chrome trace-event format, loadable directly in Perfetto
+(https://ui.perfetto.dev) or ``chrome://tracing``.
+
+Zero-overhead contract (same shape as ``resilience/inject.py``): with no
+tracer active, :func:`span` returns one shared ``nullcontext`` singleton
+and :func:`instant` is a module-global load plus an ``is None`` test —
+and ``utils/timing.py`` (the only module that brackets device work with
+clocks) never imports this package at all, pinned statically by
+``tests/test_obs.py``.  Spans wrap timed regions from the OUTSIDE only;
+the ``profiler-in-timed-region`` comm-lint rule polices the device-side
+(``jax.profiler``) half of that contract.
+
+Timestamps are ``time.perf_counter`` relative to tracer start (the
+monotonic clock — wall-clock timestamps live in the ``otherData``
+metadata block, outside every event), in microseconds as the trace-event
+spec requires.  Thread ids are real ``threading.get_ident`` values, so
+the compile-ahead worker renders as its own track.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+SPAN_SCHEMA = "dlbb_span_trace_v1"
+
+# shared disabled-path singleton: ``span()`` with no tracer active returns
+# THIS object every time (one allocation for the whole process)
+_NULL_SPAN = contextlib.nullcontext()
+
+_TRACER: Optional["SpanTracer"] = None
+_LOCK = threading.Lock()
+
+ENV_VAR = "DLBB_SPANS"
+
+
+def default_span_path() -> Optional[str]:
+    """The env-switched default (``DLBB_SPANS=trace.json``), or None —
+    the span-tracing analogue of ``DLBB_TRACE_DIR``."""
+    return os.environ.get(ENV_VAR) or None
+
+
+class SpanTracer:
+    """Thread-safe in-memory trace-event collector for one session.
+
+    Events are appended under a lock (µs-scale cost, only while tracing
+    is on); :meth:`finish` writes the whole trace atomically
+    (``utils/config.atomic_write_text``) so a crash mid-write can never
+    leave a torn JSON behind.
+    """
+
+    def __init__(self, path: "str | Path",
+                 meta: Optional[dict[str, Any]] = None) -> None:
+        self.path = Path(path)
+        self.meta = dict(meta or {})
+        self._events: list[dict[str, Any]] = []
+        self._elock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        # wall-clock anchor for humans correlating with the journal;
+        # lives in otherData, never in an event timestamp
+        self.started_at = time.time()
+
+    # -- event emission ----------------------------------------------------
+
+    def _ts_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _emit(self, ev: dict[str, Any]) -> None:
+        with self._elock:
+            self._events.append(ev)
+
+    def begin(self, name: str, cat: str = "harness",
+              args: Optional[dict[str, Any]] = None) -> None:
+        self._emit({"name": name, "cat": cat, "ph": "B",
+                    "ts": self._ts_us(), "pid": self._pid,
+                    "tid": threading.get_ident(),
+                    **({"args": args} if args else {})})
+
+    def end(self, name: str, cat: str = "harness") -> None:
+        self._emit({"name": name, "cat": cat, "ph": "E",
+                    "ts": self._ts_us(), "pid": self._pid,
+                    "tid": threading.get_ident()})
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = "harness",
+             **args: Any) -> Iterator[None]:
+        self.begin(name, cat, args=_jsonable(args))
+        try:
+            yield
+        finally:
+            self.end(name, cat)
+
+    def instant(self, name: str, cat: str = "event",
+                args: Optional[dict[str, Any]] = None) -> None:
+        """A zero-duration marker (journal events, retries, preemptions).
+        Scope "t" (thread) keeps concurrent instants on their own
+        tracks."""
+        self._emit({"name": name, "cat": cat, "ph": "i", "s": "t",
+                    "ts": self._ts_us(), "pid": self._pid,
+                    "tid": threading.get_ident(),
+                    **({"args": _jsonable(args)} if args else {})})
+
+    def events(self) -> list[dict[str, Any]]:
+        with self._elock:
+            return list(self._events)
+
+    # -- output ------------------------------------------------------------
+
+    def finish(self) -> Path:
+        """Write the trace JSON atomically and return its path.  The
+        tracer stays usable (a later finish rewrites with more events),
+        so crash paths can checkpoint the trace early."""
+        from dlbb_tpu.utils.config import atomic_write_text
+
+        payload = {
+            "traceEvents": self.events(),
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "schema": SPAN_SCHEMA,
+                "pid": self._pid,
+                "started_at": self.started_at,
+                **self.meta,
+            },
+        }
+        return atomic_write_text(json.dumps(payload), self.path)
+
+
+def _jsonable(args: dict[str, Any]) -> dict[str, Any]:
+    """Trace args must be JSON-serialisable; coerce the stragglers
+    (paths, numpy scalars) to strings rather than crash the harness."""
+    out: dict[str, Any] = {}
+    for k, v in args.items():
+        if isinstance(v, (str, int, float, bool)) or v is None:
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# module-level (zero-overhead) surface
+# ---------------------------------------------------------------------------
+
+
+def active() -> Optional[SpanTracer]:
+    return _TRACER
+
+
+def start(path: "str | Path",
+          meta: Optional[dict[str, Any]] = None) -> SpanTracer:
+    """Install the process-wide tracer.  A tracer that is already active
+    WINS (first-starter owns the output file): nested activations — the
+    CLI wrapping ``run_sweep`` which opens its own tracing scope — merge
+    their events into the outer trace instead of fighting over files."""
+    global _TRACER
+    with _LOCK:
+        if _TRACER is None:
+            _TRACER = SpanTracer(path, meta=meta)
+        return _TRACER
+
+
+def stop() -> Optional[Path]:
+    """Finish + uninstall the process-wide tracer; returns the written
+    path (None when no tracer was active)."""
+    global _TRACER
+    with _LOCK:
+        tracer, _TRACER = _TRACER, None
+    if tracer is None:
+        return None
+    return tracer.finish()
+
+
+@contextlib.contextmanager
+def tracing(path: "Optional[str | Path]",
+            meta: Optional[dict[str, Any]] = None
+            ) -> Iterator[Optional[SpanTracer]]:
+    """Scope-based activation: no-op when ``path`` is falsy, and a pure
+    pass-through (no second tracer, no double write) when a tracer is
+    already active — the inner scope's events land in the outer trace."""
+    if not path:
+        yield _TRACER
+        return
+    if _TRACER is not None:
+        yield _TRACER
+        return
+    tracer = start(path, meta=meta)
+    try:
+        yield tracer
+    finally:
+        stop()
+
+
+def span(name: str, cat: str = "harness", **args: Any):
+    """A context manager tracing one named region — THE instrumentation
+    entry point.  Disabled = the shared nullcontext singleton (no
+    allocation, no clock read)."""
+    tracer = _TRACER
+    if tracer is None:
+        return _NULL_SPAN
+    return tracer.span(name, cat, **args)
+
+
+def instant(name: str, cat: str = "event", **args: Any) -> None:
+    tracer = _TRACER
+    if tracer is not None:
+        tracer.instant(name, cat, args=args or None)
+
+
+def journal_sink(event: str, record: dict[str, Any]) -> None:
+    """The resilience-journal sink: forwards one journal record as a
+    trace instant (``resilience/journal.py`` takes this as its ``sink``
+    parameter — the journal module itself never imports obs).  No-op
+    with no tracer active; never raises into the journal."""
+    tracer = _TRACER
+    if tracer is None:
+        return
+    try:
+        args = {k: v for k, v in record.items() if k not in ("ts", "event")}
+        tracer.instant(event, cat="journal", args=args or None)
+    except Exception:  # noqa: BLE001 — observability must not kill sweeps
+        pass
+
+
+# ---------------------------------------------------------------------------
+# trace validation + journal -> trace reconstruction
+# ---------------------------------------------------------------------------
+
+_REQUIRED_EVENT_KEYS = ("name", "ph", "ts", "pid", "tid")
+
+
+def validate_trace_events(events: list[dict[str, Any]]) -> list[str]:
+    """Schema check for a trace-event list: required keys present, known
+    phases only, and B/E pairs properly nested per (pid, tid) — the
+    invariant Perfetto needs to build flame graphs.  Returns problem
+    descriptions (empty = valid)."""
+    problems: list[str] = []
+    stacks: dict[tuple, list[str]] = {}
+    for n, ev in enumerate(events):
+        missing = [k for k in _REQUIRED_EVENT_KEYS if k not in ev]
+        if missing:
+            problems.append(f"event {n}: missing keys {missing}")
+            continue
+        ph = ev["ph"]
+        if ph not in ("B", "E", "X", "i", "I", "M", "C"):
+            problems.append(f"event {n}: unknown phase {ph!r}")
+            continue
+        key = (ev["pid"], ev["tid"])
+        if ph == "B":
+            stacks.setdefault(key, []).append(ev["name"])
+        elif ph == "E":
+            stack = stacks.setdefault(key, [])
+            if not stack:
+                problems.append(
+                    f"event {n}: E {ev['name']!r} with empty stack on "
+                    f"tid {ev['tid']}"
+                )
+            elif stack[-1] != ev["name"]:
+                problems.append(
+                    f"event {n}: E {ev['name']!r} does not close "
+                    f"B {stack[-1]!r} on tid {ev['tid']} (misnested)"
+                )
+            else:
+                stack.pop()
+        elif ph == "X" and "dur" not in ev:
+            problems.append(f"event {n}: X event without dur")
+    for key, stack in sorted(stacks.items()):
+        if stack:
+            problems.append(f"tid {key[1]}: unclosed span(s) {stack}")
+    return problems
+
+
+def load_trace(path: "str | Path") -> dict[str, Any]:
+    return json.loads(Path(path).read_text())
+
+
+def journal_to_trace(journal_dir: "str | Path",
+                     out_path: "str | Path") -> tuple[Path, int, int]:
+    """Reconstruct a sweep timeline from ``sweep_journal.jsonl`` alone
+    (``cli obs trace``): every journal event becomes a trace instant, and
+    each config's ``started`` -> ``completed``/``failed`` pair becomes a
+    complete ("X") span — so even a sweep that crashed before writing its
+    span trace yields a loadable Perfetto timeline from the fsync'd
+    journal.  Returns ``(path, events_converted, torn_lines)``."""
+    from dlbb_tpu.resilience.journal import read_journal
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    records, torn = read_journal(journal_dir)
+    if not records:
+        raise FileNotFoundError(
+            f"no parseable journal events under {journal_dir} "
+            "(is this a sweep output directory?)"
+        )
+    t0 = min(float(r["ts"]) for r in records if "ts" in r)
+    events: list[dict[str, Any]] = []
+    open_configs: dict[str, float] = {}
+    for rec in records:
+        ts_us = (float(rec.get("ts", t0)) - t0) * 1e6
+        name = rec.get("event", "?")
+        config = rec.get("config")
+        args = {k: v for k, v in rec.items() if k != "ts"}
+        if name == "started" and config:
+            open_configs[config] = ts_us
+        elif name in ("completed", "failed") and config in open_configs:
+            start_us = open_configs.pop(config)
+            events.append({
+                "name": config, "cat": f"config-{name}", "ph": "X",
+                "ts": start_us, "dur": max(ts_us - start_us, 0.0),
+                "pid": 1, "tid": 1, "args": _jsonable(args),
+            })
+        events.append({
+            "name": name, "cat": "journal", "ph": "i", "s": "t",
+            "ts": ts_us, "pid": 1, "tid": 1, "args": _jsonable(args),
+        })
+    payload = {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": SPAN_SCHEMA,
+            "source": "sweep_journal.jsonl",
+            "journal_dir": str(journal_dir),
+            "wall_t0": t0,
+            "torn_lines": torn,
+        },
+    }
+    path = atomic_write_text(json.dumps(payload), Path(out_path))
+    return path, len(events), torn

@@ -15,6 +15,14 @@ did and what a resumed one re-ran.
 The journal is append-only across runs: a resumed sweep appends a new
 ``sweep-start`` session marker and its own events after the crashed
 session's, preserving the full history of the grid.
+
+A pluggable ``sink`` (``sink(event, record)``) mirrors every journal
+event into another observer — the sweep driver passes
+``dlbb_tpu.obs.spans.journal_sink`` so each journal line doubles as a
+span-trace instant and a crashed sweep's timeline is reconstructable
+from either artifact (``docs/observability.md``).  The sink fires even
+when file journaling is disabled (non-coordinator hosts on a pod), and
+sink exceptions are swallowed: observability must never kill a sweep.
 """
 
 from __future__ import annotations
@@ -23,7 +31,7 @@ import json
 import os
 import time
 from pathlib import Path
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 JOURNAL_NAME = "sweep_journal.jsonl"
 JOURNAL_SCHEMA = "dlbb_sweep_journal_v1"
@@ -40,11 +48,13 @@ class SweepJournal:
     """
 
     def __init__(self, out_dir: "str | Path", meta: Optional[dict] = None,
-                 enabled: bool = True) -> None:
+                 enabled: bool = True,
+                 sink: Optional[Callable[[str, dict], None]] = None) -> None:
         self.path = Path(out_dir) / JOURNAL_NAME
         self.enabled = enabled
         self.degraded = False
         self._fh = None
+        self._sink = sink
         if not enabled:
             return
         self.path.parent.mkdir(parents=True, exist_ok=True)
@@ -75,12 +85,22 @@ class SweepJournal:
 
     def event(self, event: str, config: Optional[str] = None,
               **extra: Any) -> None:
-        if self._fh is None:
+        if self._fh is None and self._sink is None:
             return
         record = {"ts": time.time(), "event": event}
         if config is not None:
             record["config"] = config
         record.update(extra)
+        if self._sink is not None:
+            # the sink observes every event, file journaling enabled or
+            # not (a non-coordinator pod host still traces locally); it
+            # must never raise into the sweep
+            try:
+                self._sink(event, record)
+            except Exception:  # noqa: BLE001 — observer isolation
+                pass
+        if self._fh is None:
+            return
         try:
             self._fh.write(json.dumps(record, default=str) + "\n")
             self._fh.flush()

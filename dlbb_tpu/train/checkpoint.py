@@ -224,22 +224,27 @@ class Checkpointer:
         step = int(jax.device_get(state.step))
         if step in self._mgr.all_steps():
             return False  # already on disk (e.g. final force after interval save)
-        saved = bool(
-            self._mgr.save(
-                step, args=ocp.args.StandardSave(_as_pytree(state)), force=force
+        from dlbb_tpu.obs import spans
+
+        with spans.span("checkpoint-save", cat="checkpoint", step=step,
+                        forced=force):
+            saved = bool(
+                self._mgr.save(
+                    step, args=ocp.args.StandardSave(_as_pytree(state)),
+                    force=force
+                )
             )
-        )
-        if saved and self.config.integrity:
-            # async checkpointing is disabled in __init__, so the wait is
-            # a no-op today; it stays for correctness if that ever flips
-            # (the manifest must hash the COMPLETED write)
-            self._mgr.wait_until_finished()
-            self._write_integrity(step)
-            if inject.fire("ckpt-corrupt"):
-                # chaos harness: bit-rot the payload AFTER its manifest —
-                # verification must reject this step and restore_or must
-                # fall back to the newest intact one
-                self._corrupt_step(step)
+            if saved and self.config.integrity:
+                # async checkpointing is disabled in __init__, so the wait
+                # is a no-op today; it stays for correctness if that ever
+                # flips (the manifest must hash the COMPLETED write)
+                self._mgr.wait_until_finished()
+                self._write_integrity(step)
+                if inject.fire("ckpt-corrupt"):
+                    # chaos harness: bit-rot the payload AFTER its
+                    # manifest — verification must reject this step and
+                    # restore_or must fall back to the newest intact one
+                    self._corrupt_step(step)
         return saved
 
     def _corrupt_step(self, step: int) -> None:

@@ -52,6 +52,7 @@ from dlbb_tpu.models.transformer import (
     forward_flops,
     init_params_sharded,
 )
+from dlbb_tpu.obs import spans
 from dlbb_tpu.utils.config import load_config, save_json
 from dlbb_tpu.utils.metrics import Timer, summarize
 from dlbb_tpu.utils.profiling import annotate, step_annotation
@@ -635,7 +636,8 @@ def run_train(
         str(k): str(v)
         for k, v in (execution.get("compiler_options") or {}).items()
     }
-    with annotate("compile+warmup"):
+    with spans.span("compile+warmup", cat="train"), \
+            annotate("compile+warmup"):
         t0 = time.perf_counter()
         if comp_opts and mode == "per_iter":
             # AOT-compile with the options; in chained mode the options are
@@ -669,7 +671,11 @@ def run_train(
                 if guard.requested:
                     preempted_at = int(jax.device_get(state.step))
                     break
-                with step_annotation("train_step", i):
+                # span + device annotation wrap the Timer from the
+                # OUTSIDE — nothing profiler-shaped inside the timed
+                # region (the profiler-in-timed-region lint contract)
+                with spans.span("train_step", cat="train", step=i), \
+                        step_annotation("train_step", i):
                     with Timer() as t:
                         state, loss = jit_step(state, batch, tgt)
                         jax.block_until_ready(loss)
@@ -702,7 +708,8 @@ def run_train(
                     new_state, _ = jit_step(st, b, t)
                     return new_state
 
-                with annotate("measure"):
+                with spans.span("measure", cat="train"), \
+                        annotate("measure"):
                     # state is donated to the timing loop (halves resident
                     # TrainState HBM — decisive for Adam at 1B on the
                     # 16 GiB chip); the returned carry IS the post-timing

@@ -24,14 +24,30 @@ from typing import Any, Optional
 import numpy as np
 
 
+# the full summary schema, empty series included: every caller can rely
+# on these keys existing (serving-path metrics — ROADMAP item 1 — key on
+# p99.9 tail latency, hence p999).  ONE source of truth with the native
+# bindings — the native path zips values against this order, so a field
+# added to only one copy would silently mislabel numbers.
+from dlbb_tpu.native import SUMMARY_FIELDS as SUMMARY_KEYS
+
+
 def summarize(values: list[float]) -> dict[str, float]:
     """Summary statistics over a timing series (seconds), matching the
-    reference's metric names (``utils.py:43-66``).  Uses the native C++
+    reference's metric names (``utils.py:43-66``) plus ``p999`` (the
+    p99.9 tail the serving-path metrics need).  Uses the native C++
     stats core when available (``dlbb_tpu/native``), numpy otherwise —
-    numerics asserted identical in ``tests/test_native.py``."""
+    numerics asserted identical in ``tests/test_native.py``.
+
+    An EMPTY series (every sample quarantined, a preempted run) returns
+    explicit NaN-valued keys with ``count == 0`` — never a bare ``{}``
+    that would KeyError the stats pipeline downstream; NaN is visibly
+    not-a-number in every artifact it reaches."""
     arr = np.asarray(values, dtype=np.float64)
     if arr.size == 0:
-        return {}
+        out = {k: float("nan") for k in SUMMARY_KEYS}
+        out["count"] = 0
+        return out
     from dlbb_tpu.native import summarize_native
 
     native = summarize_native(arr)
@@ -45,6 +61,7 @@ def summarize(values: list[float]) -> dict[str, float]:
         "median": float(np.median(arr)),
         "p95": float(np.percentile(arr, 95)),
         "p99": float(np.percentile(arr, 99)),
+        "p999": float(np.percentile(arr, 99.9)),
         "count": int(arr.size),
     }
 

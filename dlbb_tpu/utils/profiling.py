@@ -15,8 +15,17 @@ Surface, mirroring the reference's env-switched design:
   None/empty.  ``DLBB_TRACE_DIR`` env is the default, so any benchmark can
   be traced without changing its invocation (the CCL_LOG_LEVEL analogue).
 - ``annotate(name)`` — host-side named region (``TraceAnnotation``) so
-  warmup/measurement phases are distinguishable in the timeline.
+  warmup/measurement phases are distinguishable in the timeline
+  (``utils/timing.py`` wraps its warmup/measure loops in these, and
+  ``train/loop.py`` its phases).
 - ``step_annotation(name, step)`` — per-step annotation for training loops.
+
+This module is one of the two sanctioned profiler API homes (with
+``dlbb_tpu/obs/capture.py``): the ``profiler-in-timed-region`` comm-lint
+rule forbids profiler calls inside any timed region elsewhere in the
+repo, and the runtime observability layer — host-side span tracing,
+gated per-config device capture, the predicted-vs-measured calibration
+gate — lives in ``dlbb_tpu/obs/`` (``docs/observability.md``).
 """
 
 from __future__ import annotations

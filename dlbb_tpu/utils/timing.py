@@ -19,6 +19,16 @@ Two regimes (SURVEY §7 "timing semantics under async dispatch"):
 
 ``resolve_timing_mode("auto")`` picks per_iter unless the backend is known
 remote-async (or ``DLBB_TIMING_MODE`` overrides).
+
+Warmup and measurement loops run under ``jax.profiler`` trace
+annotations (``utils/profiling.annotate``), so a captured device trace
+(``--trace`` / the obs device captures) distinguishes warmup reps from
+measurement reps in the timeline.  The annotations wrap the LOOPS, never
+the inside of a per-iteration ``perf_counter`` bracket — this module is
+the sanctioned timing API (exempt from the timed-region lint rules) and
+must never import the obs or chaos-harness packages: the zero-overhead
+pins in ``tests/test_obs.py`` and the chaos suite assert, statically,
+that nothing here can add instructions to a timed region.
 """
 
 from __future__ import annotations
@@ -29,6 +39,8 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+
+from dlbb_tpu.utils.profiling import annotate
 
 def _remote_async_backend() -> bool:
     """True when the device runtime is remotely attached and
@@ -143,31 +155,34 @@ def time_fn_per_iter(
     returned/recorded so result artifacts never overstate the sample size.
     Returns ``(timings, warmup_run, clamped)``.
     """
-    jax.block_until_ready(fn(*args))  # compile + first warmup
-    warmup_run = 1
-    clamped = False
-    if max_seconds is not None:
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        probe = time.perf_counter() - t0
-        warmup_run += 1
-        # when even the 3-sample floor cannot fit the budget (huge payloads
-        # on the single-core simulated host), drop the floor to 1 — one
-        # honest recorded sample beats minutes of over-budget re-runs
-        floor = 1 if 3 * probe > max_seconds else 3
-        affordable = max(floor, int(max_seconds / max(probe, 1e-9)))
-        if affordable < warmup + iterations:
-            clamped = True
-            warmup = min(warmup, max(0, affordable // 10))
-            iterations = min(iterations, max(floor, affordable - warmup))
-    for _ in range(max(0, warmup - warmup_run)):
-        jax.block_until_ready(fn(*args))
-        warmup_run += 1
+    with annotate("warmup"):
+        jax.block_until_ready(fn(*args))  # compile + first warmup
+        warmup_run = 1
+        clamped = False
+        if max_seconds is not None:
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            probe = time.perf_counter() - t0
+            warmup_run += 1
+            # when even the 3-sample floor cannot fit the budget (huge
+            # payloads on the single-core simulated host), drop the floor
+            # to 1 — one honest recorded sample beats minutes of
+            # over-budget re-runs
+            floor = 1 if 3 * probe > max_seconds else 3
+            affordable = max(floor, int(max_seconds / max(probe, 1e-9)))
+            if affordable < warmup + iterations:
+                clamped = True
+                warmup = min(warmup, max(0, affordable // 10))
+                iterations = min(iterations, max(floor, affordable - warmup))
+        for _ in range(max(0, warmup - warmup_run)):
+            jax.block_until_ready(fn(*args))
+            warmup_run += 1
     out = []
-    for _ in range(iterations):
-        t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        out.append(time.perf_counter() - t0)
+    with annotate("measure"):
+        for _ in range(iterations):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            out.append(time.perf_counter() - t0)
     return out, warmup_run, clamped
 
 
@@ -257,12 +272,13 @@ def time_fn_chained(
             )
 
     warm_wall = float("inf")
-    for _ in range(max(1, warmup)):
-        t0 = time.perf_counter()
-        x = looped(op_args, x)  # rebind: the donated input is now invalid
-        _force(x)
-        warm_wall = min(warm_wall, time.perf_counter() - t0)
-    overhead = calibrate_fetch_overhead(x)
+    with annotate("warmup"):
+        for _ in range(max(1, warmup)):
+            t0 = time.perf_counter()
+            x = looped(op_args, x)  # rebind: donated input is now invalid
+            _force(x)
+            warm_wall = min(warm_wall, time.perf_counter() - t0)
+        overhead = calibrate_fetch_overhead(x)
 
     clamped = False
     if max_seconds is not None and warm_wall > 0:
@@ -271,12 +287,13 @@ def time_fn_chained(
             chunks, clamped = affordable, True
 
     samples = []
-    for _ in range(chunks):
-        t0 = time.perf_counter()
-        x = looped(op_args, x)
-        _force(x)
-        wall = time.perf_counter() - t0
-        samples.append(max(wall - overhead, 0.0) / chunk_size)
+    with annotate("measure"):
+        for _ in range(chunks):
+            t0 = time.perf_counter()
+            x = looped(op_args, x)
+            _force(x)
+            wall = time.perf_counter() - t0
+            samples.append(max(wall - overhead, 0.0) / chunk_size)
     meta = {
         "timing_mode": "chained",
         "timing_method": (

@@ -32,6 +32,25 @@ JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze diff --simulate 8
 JAX_PLATFORMS=cpu python -m pytest tests/test_schedule_audit.py -q \
     -m schedule_smoke -p no:cacheprovider
 
+# obs_smoke (docs/observability.md): a span-traced + device-captured
+# mini-sweep must publish stats equivalent to an untraced serial run
+# (dedicated profile reps never enter the stats series; the span trace
+# is valid Perfetto-loadable trace-event JSON), then the
+# predicted-vs-measured calibration loop — `cli obs calibrate` on a
+# micro-op subset joined against the committed α–β schedule baselines,
+# and `cli obs diff` against the committed sim-tier calibration
+# baseline (stats/analysis/calibration/), failing when the cost-model
+# error regresses past the slack.  The profiler-in-timed-region lint
+# rule gating captures runs in `analyze all` above.  Exit codes pinned
+# 0 clean / 1 findings / 2 crash, like every other gate here.
+JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py -q \
+    -m obs_smoke -p no:cacheprovider
+OBS_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli obs diff --simulate 8 \
+    --output "$OBS_TMP" --targets "::allgather" "::alltoall" "::barrier" \
+    --reps 15 --warmup 5
+rm -rf "$OBS_TMP"
+
 # compile-ahead sweep-engine smoke (bench/schedule.py is covered by the
 # lint pass above; this exercises the pipelined path end-to-end on the
 # simulated mesh — 2-op mini-sweep, compile accounting, manifest)

@@ -65,6 +65,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "obs_smoke: observability smoke — traced+captured sweep stats "
+        "equivalence and the calibration calibrate/diff round trip "
+        "(tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )

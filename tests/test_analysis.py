@@ -428,6 +428,79 @@ def test_lint_wallclock_suppression():
     assert suppressed == 1
 
 
+PROFILER_TIMER_FIXTURE = textwrap.dedent("""
+    import jax
+    from dlbb_tpu.utils.metrics import Timer
+
+    def bench(fn, x):
+        with Timer(sync=x) as t:
+            with jax.profiler.trace("/tmp/trace"):
+                y = fn(x)
+        return t.elapsed, y
+""")
+
+
+def test_lint_profiler_in_timer_block():
+    """A profiler session inside a Timer block contaminates the number
+    being published — capture belongs on a dedicated profile rep
+    outside the region (docs/observability.md); no bracketing
+    exemption."""
+    findings, _ = lint_source(PROFILER_TIMER_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["profiler-in-timed-region"]
+    assert "jax.profiler.trace" in findings[0].message
+
+
+def test_lint_profiler_in_perf_counter_region():
+    src = textwrap.dedent("""
+        import time
+        from dlbb_tpu.utils.profiling import annotate
+
+        def bench(fn, x):
+            t0 = time.perf_counter()
+            with annotate("measure"):
+                y = fn(x)
+            elapsed = time.perf_counter() - t0
+            return elapsed, y
+    """)
+    findings, _ = lint_source(src, "fixture.py")
+    assert [f.rule for f in findings] == ["profiler-in-timed-region"]
+    # the sanctioned pattern — the annotation WRAPS the timed region
+    # (what train/loop.py and utils/timing.py do) — is clean
+    moved = textwrap.dedent("""
+        import time
+        from dlbb_tpu.utils.profiling import annotate
+
+        def bench(fn, x):
+            with annotate("measure"):
+                t0 = time.perf_counter()
+                y = fn(x)
+                elapsed = time.perf_counter() - t0
+            return elapsed, y
+    """)
+    assert lint_source(moved, "fixture.py")[0] == []
+
+
+def test_lint_profiler_rule_exempts_api_homes():
+    """utils/profiling.py and obs/capture.py ARE the capture API — the
+    timed-region profiler rule must not fire on their own internals
+    (obs/capture.py times its capture's wall cost by design)."""
+    findings, _ = lint_source(
+        PROFILER_TIMER_FIXTURE, "dlbb_tpu/obs/capture.py"
+    )
+    assert findings == []
+
+
+def test_lint_profiler_suppression():
+    src = PROFILER_TIMER_FIXTURE.replace(
+        'with jax.profiler.trace("/tmp/trace"):',
+        'with jax.profiler.trace("/tmp/trace"):  '
+        "# comm-lint: disable=profiler-in-timed-region",
+    )
+    findings, suppressed = lint_source(src, "fixture.py")
+    assert findings == []
+    assert suppressed == 1
+
+
 SET_ITER_FIXTURE = textwrap.dedent("""
     NAMES_A = ("b", "a")
     NAMES_B = ("c",)

@@ -34,18 +34,36 @@ def test_summarize_matches_numpy():
                                    rtol=1e-12)
         np.testing.assert_allclose(got["p99"], np.percentile(xs, 99),
                                    rtol=1e-12)
+        np.testing.assert_allclose(got["p999"], np.percentile(xs, 99.9),
+                                   rtol=1e-12)
 
 
 def test_summarize_used_by_metrics():
     """utils.metrics.summarize routes through the native core and keeps
-    its schema."""
+    its schema (p999 included — the serving-path tail metric)."""
     from dlbb_tpu.utils.metrics import summarize
 
     xs = RNG.normal(size=256).tolist()
     out = summarize(xs)
     assert set(out) == {"mean", "std", "min", "max", "median", "p95",
-                        "p99", "count"}
+                        "p99", "p999", "count"}
     np.testing.assert_allclose(out["p95"], np.percentile(xs, 95), rtol=1e-12)
+    np.testing.assert_allclose(out["p999"], np.percentile(xs, 99.9),
+                               rtol=1e-12)
+
+
+def test_summarize_empty_series_contract():
+    """An empty series returns explicit NaN-valued keys with count 0 —
+    never a bare {} a downstream stats pass would KeyError on — through
+    BOTH dispatch paths (native returns None on empty; the metrics
+    layer owns the contract)."""
+    from dlbb_tpu.utils.metrics import SUMMARY_KEYS, summarize
+
+    assert summarize_native([]) is None
+    out = summarize([])
+    assert set(out) == set(SUMMARY_KEYS)
+    assert out["count"] == 0
+    assert all(np.isnan(v) for k, v in out.items() if k != "count")
 
 
 def test_load_imbalance_matches_reference_formula():
