@@ -139,12 +139,29 @@ def plan_expected_kinds(dp: int = 1, tp: int = 1, sp: int = 1, pp: int = 1,
                         ep: int = 1, attention: str = "full",
                         zero_stage: int = 0,
                         tp_overlap: str = "off",
-                        compression: str = "none") -> set[str]:
+                        compression: str = "none",
+                        decode: bool = False) -> set[str]:
     """The union of collective kinds a (plan, attention, ZeRO stage,
     tp_overlap schedule, grad-compression mode) combination is allowed to
     lower to.  Anything else in the compiled module — most importantly an
     all-gather in a plain TP forward, or a surviving all-reduce in an
-    overlapped one — is a sharding mismatch."""
+    overlapped one — is a sharding mismatch.
+
+    ``decode=True`` is the serving inference step (decode AND the prefill
+    cache-append step, ``dlbb_tpu/serve/engine.py``): there is no
+    gradient reduction, so dp — pure batch parallelism over the cache
+    slots — contributes NOTHING, and the only legal collectives are tp's
+    tiny per-token row-parallel psums + QKV realignment permutes.  The
+    KV-cache itself must never reach the wire; the serving audit targets
+    pair this set with an activation-sized byte ceiling, so a cache
+    regather (slot-cache-sized all-gather) fails on BOTH axes."""
+    if decode:
+        if sp > 1 or pp > 1 or ep > 1:
+            raise ValueError(
+                "decode=True models the serving step, which runs on "
+                f"(dp, tp) meshes only (got sp={sp}, pp={pp}, ep={ep})"
+            )
+        return set(AXIS_EXPECTED_KINDS["tp"]) if tp > 1 else set()
     kinds: set[str] = set()
     if dp > 1:
         if compression not in (None, "none"):

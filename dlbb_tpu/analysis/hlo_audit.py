@@ -631,6 +631,114 @@ def _compressed_train_target(compression: str = "int8",
     )
 
 
+# Serving audit geometry (dlbb_tpu/serve/): the tiny model on a dp2 x
+# tp4 mesh, 4 decode slots of 4 x 8-token cache blocks, one 16-token
+# prefill bucket.  Shared by the decode and prefill targets so their
+# byte ceilings price the same cache.
+_SERVE_SHAPE = dict(max_batch=4, num_blocks=4, block_size=8, bucket=16)
+
+
+def _serve_build(dp: int, tp: int, what: str):
+    """Common builder for the serving targets: engine jits + example
+    args on a (dp, tp) mesh — the exact programs ``serve/engine.py``
+    runs, so the audit gates the real decode/prefill lowering."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from dlbb_tpu.comm.mesh import build_parallelism_mesh
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.models.transformer import init_params_sharded
+    from dlbb_tpu.serve.engine import build_decode_step, build_prefill
+    from dlbb_tpu.serve.kvcache import create_kv_cache
+
+    cfg = ModelConfig(**_TINY_MODEL)
+    mesh = build_parallelism_mesh(data_parallel=dp, tensor_parallel=tp)
+    params = init_params_sharded(cfg, jax.random.key(0), mesh)
+    cache = create_kv_cache(
+        cfg, _SERVE_SHAPE["max_batch"], _SERVE_SHAPE["num_blocks"],
+        _SERVE_SHAPE["block_size"], mesh=mesh,
+    )
+    if what == "decode":
+        fn = build_decode_step(cfg, mesh)
+        x = jax.device_put(
+            jnp.zeros((_SERVE_SHAPE["max_batch"], 1, cfg.hidden_size),
+                      jnp.float32),
+            NamedSharding(mesh, P("dp", None, None)),
+        )
+        active = jax.device_put(
+            jnp.ones((_SERVE_SHAPE["max_batch"],), bool),
+            NamedSharding(mesh, P()),
+        )
+        return fn, ((cache, x), params, active)
+    fn = build_prefill(cfg, mesh)
+    x = jnp.zeros((1, _SERVE_SHAPE["bucket"], cfg.hidden_size),
+                  jnp.float32)
+    return fn, (cache, params, x, np.int32(0),
+                np.int32(_SERVE_SHAPE["bucket"]))
+
+
+def _decode_step_target(dp: int = 2, tp: int = 4) -> AuditTarget:
+    """The serving decode step (``serve/engine.py::decode_step``).  The
+    contract is the serving-path comm story: ONLY tiny per-token tp
+    collectives (row-parallel psums of [max_batch, 1, H] + QKV realign
+    permutes) may exist — dp contributes nothing (no gradients) — and
+    the activation-sized byte ceiling is the proof that no step
+    re-gathers the KV-cache: even one slot's single-layer cache shard is
+    several times the ceiling, so a cache regather fails on both the
+    kind axis and the byte axis.  The cache carry must stay donated
+    (an undonated decode doubles cache HBM — fatal at real sizes)."""
+    def build():
+        return _serve_build(dp, tp, "decode")
+
+    cfg_dict = _TINY_MODEL
+    # largest legitimate instruction: an all-reduce (or realign permute)
+    # of one decode step's activations — [max_batch, 1, qkv_width] f32
+    # bounds every projection collective.  One layer's k (or v) cache
+    # plane [max_batch, num_blocks, block_size, kvh, d] is ~8.5x this
+    # ceiling (a single slot's plane alone is ~2x), so any cache-sized
+    # transfer trips.
+    qkv_width = 3 * cfg_dict["hidden_size"]
+    act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    return AuditTarget(
+        name="serve/engine.py::decode_step[dp,tp]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=dp, tp=tp, decode=True),
+            required_any={"all-reduce"},
+            min_required=1,  # row-parallel psum per scanned layer
+            max_bytes_per_instr=int(act_bytes * 1.25),
+            expect_donation=True,
+        ),
+        min_devices=dp * tp,
+    )
+
+
+def _prefill_target(dp: int = 2, tp: int = 4) -> AuditTarget:
+    """The serving prefill (cache-append) step: full causal attention
+    over one request's bucketed prompt, K/V written into the request's
+    slot by masked select.  Same kind set as decode; the ceiling is one
+    bucket of activations — the cache write itself must lower to zero
+    collectives (a write that round-trips the wire would trip it)."""
+    def build():
+        return _serve_build(dp, tp, "prefill")
+
+    act_bytes = _SERVE_SHAPE["bucket"] * 3 * _TINY_MODEL["hidden_size"] * 4
+    return AuditTarget(
+        name="serve/engine.py::prefill[dp,tp]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=dp, tp=tp, decode=True),
+            required_any={"all-reduce"},
+            min_required=1,
+            max_bytes_per_instr=int(act_bytes * 1.25),
+            expect_donation=True,
+        ),
+        min_devices=dp * tp,
+    )
+
+
 def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
     def build():
         import jax
@@ -700,8 +808,9 @@ def registry_op_targets() -> list[AuditTarget]:
 def default_targets() -> list[AuditTarget]:
     """The repo's standing audit surface: every registry collective, the
     TP/sequence-parallel model forwards (the e2e benchmark's jit) with
-    and without the overlapped collective-matmul schedule, and the
-    DDP + ZeRO-1 + overlapped-TP train steps."""
+    and without the overlapped collective-matmul schedule, the
+    DDP + ZeRO-1 + overlapped-TP train steps, and the serving decode +
+    prefill steps (tiny-collectives-only, cache-regather byte gate)."""
     targets = registry_op_targets()
     targets.append(_barrier_target())
     targets.append(_tp_forward_target())
@@ -713,6 +822,8 @@ def default_targets() -> list[AuditTarget]:
     targets.append(_train_step_target(zero_stage=1))
     targets.append(_tp_overlap_train_target("ring"))
     targets.append(_compressed_train_target("int8"))
+    targets.append(_decode_step_target())
+    targets.append(_prefill_target())
     return targets
 
 

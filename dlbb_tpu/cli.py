@@ -243,6 +243,53 @@ def build_parser() -> argparse.ArgumentParser:
                     help="workdir for the gate's artifacts (default: a "
                          "fresh temp dir, kept on failure)")
 
+    sv = sub.add_parser(
+        "serve",
+        help="continuous-batching serving benchmark: a synthetic traffic "
+             "trace served through the paged-KV-cache inference engine; "
+             "reports goodput, TTFT / per-token latency p50/p99/p99.9, "
+             "queue depth and cache occupancy (docs/serving.md)",
+    )
+    sv.add_argument("--config", default=None,
+                    help="experiment YAML with model/parallelism/serving "
+                         "sections (default: a small GQA model on an "
+                         "auto-planned (dp, tp) mesh)")
+    sv.add_argument("--trace", default="poisson",
+                    help="arrival process (poisson, bursty, diurnal) or a "
+                         "path to a saved trace JSON (replay)")
+    sv.add_argument("--requests", type=int, default=100,
+                    help="requests to generate (generated traces only)")
+    sv.add_argument("--rate", type=float, default=None,
+                    help="mean arrival rate in req/s (default 32)")
+    sv.add_argument("--seed", type=int, default=42,
+                    help="trace seed (arrivals, lengths, embeddings)")
+    sv.add_argument("--max-batch", type=int, default=None,
+                    dest="max_batch", help="decode slots (default 8)")
+    sv.add_argument("--block-size", type=int, default=None,
+                    dest="block_size",
+                    help="KV-cache tokens per block (default 16)")
+    sv.add_argument("--max-seq", type=int, default=None, dest="max_seq",
+                    help="per-slot prompt+output ceiling (default 256)")
+    sv.add_argument("--queue-capacity", type=int, default=None,
+                    dest="queue_capacity",
+                    help="admission-control queue bound (default 64)")
+    sv.add_argument("--output", default=None,
+                    help="output directory (default results/serving)")
+    sv.add_argument("--simulate", type=int, default=0, metavar="N")
+    # --trace names the TRAFFIC here, so the xplane flag gets a
+    # serve-specific name (main() routes it into maybe_trace)
+    sv.add_argument("--xplane-trace", default=None, metavar="DIR",
+                    dest="xplane_trace",
+                    help="write an XLA profiler trace (xplane) to DIR "
+                         "(the --trace flag of the other levels; "
+                         "DLBB_TRACE_DIR env is the default)")
+    sv.add_argument("--span-trace", default=None, metavar="FILE",
+                    dest="span_trace",
+                    help="write a host-side span trace (Chrome "
+                         "trace-event JSON) of the run to FILE; "
+                         "DLBB_SPANS env is the default "
+                         "(docs/observability.md)")
+
     tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
     tr.add_argument("--simulate", type=int, default=0, metavar="N")
@@ -276,7 +323,7 @@ def main(argv: list[str] | None = None) -> int:
         force_cpu_simulation(args.simulate)
     elif (
         os.environ.get("DLBB_DISTRIBUTED") == "auto"
-        and args.cmd in ("bench1d", "bench3d", "e2e", "train")
+        and args.cmd in ("bench1d", "bench3d", "e2e", "train", "serve")
     ):
         # pod launcher path (launch/launch_tpu_pod.sh): stand up
         # jax.distributed across hosts before any backend use; stats
@@ -298,7 +345,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"error: {e.args[0]}")
             return 2
 
-    if args.cmd in ("bench1d", "bench3d", "e2e", "train"):
+    if args.cmd in ("bench1d", "bench3d", "e2e", "train", "serve"):
         # stats subcommands are pure numpy file processing — no backend,
         # no profiler, and no jax import even when DLBB_TRACE_DIR is set
         from dlbb_tpu.obs import spans
@@ -306,8 +353,13 @@ def main(argv: list[str] | None = None) -> int:
 
         span_path = getattr(args, "span_trace", None) \
             or spans.default_span_path()
+        # serve's --trace selects the traffic; its xplane dir rides the
+        # dedicated --xplane-trace flag
+        profile_dir = (getattr(args, "xplane_trace", None)
+                       if args.cmd == "serve"
+                       else getattr(args, "trace", None))
         with spans.tracing(span_path, meta={"cmd": args.cmd}) as tracer, \
-                maybe_trace(getattr(args, "trace", None)) as trace_dir:
+                maybe_trace(profile_dir) as trace_dir:
             rc = _dispatch(args)
         if trace_dir:
             print(f"[trace] xplane trace written to {trace_dir}")
@@ -498,6 +550,19 @@ def _dispatch(args) -> int:
         else:
             print(f"cp_scaling: no train_ddp_cp_s*.json under {cp_dir} — "
                   "skipped")
+        serve_dir = results_root / "serving"
+        if any(p.name != "serving_manifest.json"
+               for p in serve_dir.rglob("serving_*.json")):
+            from dlbb_tpu.stats.serving_report import write_serving_report
+
+            srows = write_serving_report(serve_dir, stats_root / "serving")
+            if srows:
+                produced += 1
+                print(f"serving: {len(srows)} run(s) -> "
+                      f"{stats_root / 'serving' / 'SERVING.md'}")
+        else:
+            print(f"serving: no serving_*.json under {serve_dir} — "
+                  "skipped")
         from dlbb_tpu.stats.northstar import (
             default_stats_1d_csv,
             write_northstar_report,
@@ -554,6 +619,31 @@ def _dispatch(args) -> int:
         result = run_e2e_from_config(args.config, output_dir=args.output,
                                      tp_overlap=args.tp_overlap)
         print(f"forward mean {result['forward_time']['mean'] * 1e3:.2f} ms")
+        return 0
+
+    if args.cmd == "serve":
+        from dlbb_tpu.serve.bench import run_serve_from_config
+
+        result = run_serve_from_config(
+            args.config,
+            trace=args.trace,
+            num_requests=args.requests,
+            seed=args.seed,
+            rate=args.rate,
+            output_dir=args.output,
+            overrides={
+                "max_batch": args.max_batch,
+                "block_size": args.block_size,
+                "max_seq": args.max_seq,
+                "queue_capacity": args.queue_capacity,
+            },
+        )
+        req = result["requests"]
+        print(
+            f"goodput {result['goodput_tokens_per_s']:.0f} tok/s over "
+            f"{req['completed']} completed / {req['rejected']} rejected "
+            f"request(s)"
+        )
         return 0
 
     if args.cmd == "train":

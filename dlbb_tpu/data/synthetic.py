@@ -49,6 +49,38 @@ class SyntheticEmbeddingDataset:
         return self._batch
 
 
+def request_embeddings(
+    seed: int,
+    prompt_len: int,
+    hidden_size: int,
+    dtype=jnp.bfloat16,
+    pad_to: Optional[int] = None,
+) -> jax.Array:
+    """Seeded synthetic prompt embeddings for ONE serving request:
+    ``[1, prompt_len, hidden]`` (``[1, pad_to, hidden]`` when padded for a
+    prefill bucket — pad positions are zeros; causal attention plus the
+    engine's length masking keep them out of every real token's output).
+
+    The serving analogue of :class:`SyntheticEmbeddingDataset`: the
+    benchmark measures scheduling and communication, not input variety,
+    but each request still gets its own deterministic inputs (seed from
+    the trace, ``serve/traffic.py``) so a replayed trace replays the
+    exact computation."""
+    if pad_to is not None and pad_to < prompt_len:
+        raise ValueError(
+            f"pad_to={pad_to} is shorter than prompt_len={prompt_len}"
+        )
+    rng = np.random.default_rng(seed)
+    host = rng.standard_normal((1, prompt_len, hidden_size),
+                               dtype=np.float32)
+    if pad_to is not None and pad_to > prompt_len:
+        host = np.concatenate(
+            [host, np.zeros((1, pad_to - prompt_len, hidden_size),
+                            dtype=np.float32)], axis=1,
+        )
+    return jnp.asarray(host, dtype=dtype)
+
+
 def create_dataset_from_config(
     config: dict[str, Any],
     mesh: Optional[Mesh] = None,

@@ -258,6 +258,94 @@ def validate_expert_parallelism(config: ModelConfig, ep: int) -> None:
         )
 
 
+# Attention modes the serving engine's paged-cache path supports: the
+# cache stores K/V at kv_heads width and decode attends over it with the
+# exact dense kernel, so only the exact-MHA modes qualify ("simplified"
+# has no K/V at all; ring/ulysses partition the sequence the cache owns).
+SERVABLE_ATTENTION = ("full", "dense")
+
+_DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
+
+
+def kv_cache_bytes(config: ModelConfig, max_batch: int,
+                   max_seq: int) -> int:
+    """Total (unsharded) KV-cache footprint of a serving config: K + V,
+    every layer, every slot, ``max_seq`` tokens at GQA ``kv_heads``
+    width, in the model dtype."""
+    return (2 * config.num_layers * max_batch * max_seq
+            * config.kv_heads * config.head_dim
+            * _DTYPE_BYTES[config.dtype])
+
+
+def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
+                     block_size: int, dp: int = 1, tp: int = 1,
+                     hbm_budget_bytes: Optional[int] = None) -> None:
+    """Reject serving configurations the engine cannot run — at build
+    time, with a clear error, never as an OOM (or a wrong answer) in the
+    middle of a trace.
+
+    Covers the model envelope (exact-MHA attention, dense FFN, no
+    tp_overlap), the cache divisibility contract (blocks tile max_seq;
+    dp tiles the slot dim; tp tiles kv_heads), and — when
+    ``hbm_budget_bytes`` is set — the per-device KV-cache HBM footprint:
+    ``max_batch x max_seq`` K/V at kv_heads width, divided by the dp x tp
+    shards that actually partition it."""
+    if config.attention not in SERVABLE_ATTENTION:
+        raise ValueError(
+            f"serving requires attention in {SERVABLE_ATTENTION} "
+            f"(attention={config.attention!r}: the paged KV-cache stores "
+            "exact per-position K/V; simplified has none and ring/ulysses "
+            "partition the sequence the cache owns)"
+        )
+    if config.is_moe:
+        raise ValueError(
+            "serving requires a dense FFN (model.num_experts == 0); the "
+            "MoE dispatch path is not wired into the decode step"
+        )
+    if config.tp_overlap != "off":
+        raise ValueError(
+            f"serving requires model.tp_overlap='off' (got "
+            f"{config.tp_overlap!r}): the ring schedules gather the "
+            "sequence dim, which decode steps of length 1 cannot shard"
+        )
+    if max_batch < 1:
+        raise ValueError(f"serving.max_batch must be >= 1, got {max_batch}")
+    if block_size < 1 or max_seq % block_size != 0:
+        raise ValueError(
+            f"serving.max_seq={max_seq} must be a positive multiple of "
+            f"serving.block_size={block_size} (the cache is paged in "
+            "whole blocks)"
+        )
+    if dp > 1 and max_batch % dp != 0:
+        raise ValueError(
+            f"serving.max_batch={max_batch} not divisible by dp={dp} "
+            "(decode slots shard over the dp axis)"
+        )
+    if tp > 1 and config.kv_heads % tp != 0:
+        raise ValueError(
+            f"kv_heads={config.kv_heads} not divisible by tp={tp}: the "
+            "KV-cache shards its head dim over tp, so GQA configs need "
+            "kv_heads % tp == 0 (pick a smaller tp or more kv heads)"
+        )
+    if hbm_budget_bytes is not None:
+        total = kv_cache_bytes(config, max_batch, max_seq)
+        shards = max(1, dp) * (tp if tp > 1 else 1)
+        per_device = total // shards
+        if per_device > hbm_budget_bytes:
+            raise ValueError(
+                f"serving KV-cache footprint {per_device / 2**30:.2f} GiB "
+                f"per device (max_batch={max_batch} x max_seq={max_seq} "
+                f"x {config.num_layers} layers x kv_heads="
+                f"{config.kv_heads} x head_dim={config.head_dim} x 2 "
+                f"(K+V) x {_DTYPE_BYTES[config.dtype]} B "
+                f"[{config.dtype}], sharded over dp={dp} x tp={tp}) "
+                f"exceeds the HBM budget of "
+                f"{hbm_budget_bytes / 2**30:.2f} GiB — shrink max_batch/"
+                "max_seq or raise serving.hbm_budget_gb if the device "
+                "really has the headroom"
+            )
+
+
 # Reference sizes (``models.py:252-271``).
 MODEL_CONFIGS: dict[str, ModelConfig] = {
     "1B": ModelConfig(hidden_size=2048, num_layers=24, num_heads=16,

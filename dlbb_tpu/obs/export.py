@@ -189,6 +189,45 @@ class LabeledCounter(Mapping):
         return len(self._keys)
 
 
+def serving_metrics(report: dict[str, Any],
+                    registry: Optional[MetricsRegistry] = None
+                    ) -> MetricsRegistry:
+    """Fold a serving report (``serve/engine.py``) into gauges on top of
+    the live counters/gauges the engine already registered — the serving
+    analogue of :func:`sweep_metrics`, written as ``metrics.prom`` next
+    to every serving run's manifest.
+
+    The request-outcome counters (arrived/admitted/rejected/completed)
+    are registry-backed during the run (``serve_requests``), so the
+    report and the export share one source; this adds the derived
+    summary numbers (goodput, tail latencies, cache peaks)."""
+    registry = registry or MetricsRegistry()
+    registry.set_gauge("serve_goodput_tokens_per_second",
+                       report.get("goodput_tokens_per_s", 0.0),
+                       help="completed-request output tokens per second")
+    registry.set_gauge("serve_throughput_tokens_per_second",
+                       report.get("throughput_tokens_per_s", 0.0),
+                       help="all generated tokens per second")
+    registry.set_gauge("serve_wall_seconds",
+                       report.get("wall_seconds", 0.0),
+                       help="trace wall-clock time")
+    registry.set_gauge("serve_decode_steps",
+                       report.get("decode_steps", 0),
+                       help="continuous-batching decode steps executed")
+    for metric, key in (("serve_ttft_seconds", "ttft"),
+                        ("serve_per_token_seconds", "per_token_latency")):
+        summary = report.get(key, {})
+        for q in ("median", "p95", "p99", "p999"):
+            if q in summary:
+                registry.set_gauge(metric, summary[q], quantile=q)
+    cache = report.get("cache", {})
+    for k in ("blocks_in_use", "peak_blocks_in_use",
+              "peak_blocks_reserved", "total_blocks"):
+        if k in cache:
+            registry.set_gauge("serve_cache_blocks", cache[k], stat=k)
+    return registry
+
+
 def sweep_metrics(manifest: dict[str, Any],
                   registry: Optional[MetricsRegistry] = None
                   ) -> MetricsRegistry:

@@ -295,7 +295,10 @@ def journal_to_trace(journal_dir: "str | Path",
     each config's ``started`` -> ``completed``/``failed`` pair becomes a
     complete ("X") span — so even a sweep that crashed before writing its
     span trace yields a loadable Perfetto timeline from the fsync'd
-    journal.  Returns ``(path, events_converted, torn_lines)``."""
+    journal.  Serving journals (``serve/engine.py``) pair the same way:
+    ``request-arrived`` -> ``request-completed``/``request-rejected``
+    becomes each request's end-to-end span (queueing included).
+    Returns ``(path, events_converted, torn_lines)``."""
     from dlbb_tpu.resilience.journal import read_journal
     from dlbb_tpu.utils.config import atomic_write_text
 
@@ -313,12 +316,15 @@ def journal_to_trace(journal_dir: "str | Path",
         name = rec.get("event", "?")
         config = rec.get("config")
         args = {k: v for k, v in rec.items() if k != "ts"}
-        if name == "started" and config:
+        if name in ("started", "request-arrived") and config:
             open_configs[config] = ts_us
-        elif name in ("completed", "failed") and config in open_configs:
+        elif (name in ("completed", "failed", "request-completed",
+                       "request-rejected") and config in open_configs):
             start_us = open_configs.pop(config)
+            kind = name[len("request-"):] if name.startswith(
+                "request-") else name
             events.append({
-                "name": config, "cat": f"config-{name}", "ph": "X",
+                "name": config, "cat": f"config-{kind}", "ph": "X",
                 "ts": start_us, "dur": max(ts_us - start_us, 0.0),
                 "pid": 1, "tid": 1, "args": _jsonable(args),
             })

@@ -1,0 +1,48 @@
+"""Serving subsystem: continuous-batching inference over a paged,
+mesh-sharded KV-cache, driven by synthetic traffic traces.
+
+- ``kvcache.py`` — the cache pytree (slot dim over dp, kv-head dim over
+  tp, GQA-aware) + host block ledger (alloc/free/append accounting);
+- ``engine.py``  — bucketed prefill / fixed-shape decode jits and the
+  continuous-batching scheduler (admission control, bounded queue,
+  step-boundary insert/evict);
+- ``traffic.py`` — seeded, replayable arrival processes (Poisson /
+  bursty MMPP / diurnal) with sampled prompt/output lengths;
+- ``bench.py``   — the trace-driven harness behind ``cli serve``
+  (atomic report JSON + manifest + metrics.prom + journal).
+
+See ``docs/serving.md`` for the architecture, cache sharding contract,
+trace schema, and report fields.
+"""
+
+from dlbb_tpu.serve.engine import (  # noqa: F401
+    ServingConfig,
+    ServingEngine,
+    build_decode_step,
+    build_prefill,
+)
+from dlbb_tpu.serve.kvcache import (  # noqa: F401
+    BlockLedger,
+    CacheOverflow,
+    KVCache,
+    create_kv_cache,
+)
+from dlbb_tpu.serve.traffic import (  # noqa: F401
+    Request,
+    TrafficTrace,
+    generate_trace,
+)
+
+__all__ = [
+    "BlockLedger",
+    "CacheOverflow",
+    "KVCache",
+    "Request",
+    "ServingConfig",
+    "ServingEngine",
+    "TrafficTrace",
+    "build_decode_step",
+    "build_prefill",
+    "create_kv_cache",
+    "generate_trace",
+]

@@ -1,0 +1,767 @@
+"""Continuous-batching inference engine over the paged KV-cache.
+
+Two jitted device programs, fixed shapes for the whole run:
+
+- **prefill** (one compile per sequence-length *bucket*): runs the full
+  transformer stack over one request's ``[1, bucket, H]`` prompt with
+  ordinary causal attention, writes its K/V into the request's cache
+  slot (block-aligned masked select — see ``serve/kvcache.py``), sets
+  the slot length, and returns the last real token's output — the
+  request's FIRST generated token (TTFT stops here).
+- **decode_step** (one compile, ``[max_batch, 1, H]``): appends each
+  active slot's pending token to the cache at its own length, attends
+  over the slot's valid prefix (length-masked, GQA-grouped at
+  ``kv_heads`` width), and produces every active slot's next token.
+  The output hidden state IS the next step's input embedding (the model
+  is its own next-token function — same convention as the chained
+  timing loop), so the decode carry ``(cache, x)`` feeds back without
+  any host round-trip, and both leaves are donated.
+
+Around them, a host-side continuous-batching scheduler (Orca-style
+iteration-level scheduling): arrivals from a ``TrafficTrace`` pass
+admission control (bounded queue — overflow is a *rejected* request),
+waiting requests are granted slots + worst-case block reservations at
+step boundaries, completed requests free both immediately, and the next
+decode step runs with whatever mix of old and new requests is resident.
+Per-phase obs spans (``serve-admission`` / ``serve-prefill`` /
+``serve-decode``), request-lifecycle events into the resilience journal,
+and live MetricsRegistry counters/gauges come for free from the
+machinery the sweep engine already has.
+
+Communication contract (audited — ``analysis/hlo_audit.py`` decode and
+prefill targets, ``plan_expected_kinds(decode=True)``): a decode step
+may contain only the tiny per-token TP collectives (row-parallel psums
+of ``[max_batch, 1, H]`` + QKV realignment permutes); the cache never
+crosses the wire.  A byte ceiling of activation size proves no step
+accidentally re-gathers the KV-cache.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlbb_tpu.data.synthetic import request_embeddings
+from dlbb_tpu.models.configs import ModelConfig, validate_serving
+from dlbb_tpu.models.attention import dense_attention
+from dlbb_tpu.models.transformer import (
+    _dtype_of,
+    _layernorm,
+    init_params_sharded,
+)
+from dlbb_tpu.obs import spans
+from dlbb_tpu.obs.export import MetricsRegistry
+from dlbb_tpu.serve.kvcache import (
+    BlockLedger,
+    KVCache,
+    cache_shardings,
+    create_kv_cache,
+)
+from dlbb_tpu.serve.traffic import Request, TrafficTrace
+from dlbb_tpu.utils.metrics import Timer, summarize
+
+SERVING_REPORT_SCHEMA = "dlbb_serving_report_v1"
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+
+
+def _default_buckets(block_size: int, max_seq: int) -> tuple[int, ...]:
+    """Doubling bucket ladder: block_size, 2x, 4x, ... up to max_seq."""
+    buckets = []
+    b = block_size
+    while b < max_seq:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_seq)
+    return tuple(buckets)
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """The serving envelope (YAML ``serving:`` section).
+
+    max_batch:       decode slots (the fixed decode batch dim).
+    block_size:      tokens per cache block.
+    max_seq:         per-slot capacity (prompt + output ceiling); must be
+                     a block multiple — ``num_blocks = max_seq/block_size``.
+    prefill_buckets: sequence-length buckets prefill compiles at
+                     (block-multiples; default: doubling ladder up to
+                     max_seq).  A prompt pads to the smallest bucket >= it.
+    queue_capacity:  admission-control bound; an arrival finding the
+                     queue full is REJECTED (counted, journaled).
+    blocks_budget:   global cache-block budget the ledger enforces
+                     (default: the physical pool, max_batch x num_blocks;
+                     set lower to model cache pressure).
+    hbm_budget_gb:   per-device HBM budget the build-time footprint gate
+                     (``models.configs.validate_serving``) checks the
+                     KV-cache against; None disables the gate.
+    """
+
+    max_batch: int = 8
+    block_size: int = 16
+    max_seq: int = 256
+    prefill_buckets: tuple[int, ...] = ()
+    queue_capacity: int = 64
+    blocks_budget: Optional[int] = None
+    hbm_budget_gb: Optional[float] = 12.0
+
+    def __post_init__(self) -> None:
+        if not self.prefill_buckets:
+            object.__setattr__(
+                self, "prefill_buckets",
+                _default_buckets(self.block_size, self.max_seq),
+            )
+        else:
+            # normalise: bucket_for's first-match walk and every
+            # "buckets[-1] is the largest" consumer assume ascending
+            # unique buckets
+            object.__setattr__(
+                self, "prefill_buckets",
+                tuple(sorted(set(self.prefill_buckets))),
+            )
+
+    @property
+    def num_blocks(self) -> int:
+        return self.max_seq // self.block_size
+
+    @property
+    def total_blocks(self) -> int:
+        return (self.blocks_budget if self.blocks_budget is not None
+                else self.max_batch * self.num_blocks)
+
+    def validate(self, config: ModelConfig, dp: int = 1,
+                 tp: int = 1) -> None:
+        budget = (None if self.hbm_budget_gb is None
+                  else int(self.hbm_budget_gb * 2**30))
+        validate_serving(config, self.max_batch, self.max_seq,
+                         self.block_size, dp=dp, tp=tp,
+                         hbm_budget_bytes=budget)
+        for b in self.prefill_buckets:
+            if b % self.block_size != 0 or not 0 < b <= self.max_seq:
+                raise ValueError(
+                    f"prefill bucket {b} must be a block_size="
+                    f"{self.block_size} multiple in (0, {self.max_seq}]"
+                )
+        if self.queue_capacity < 1:
+            raise ValueError(
+                f"serving.queue_capacity must be >= 1, got "
+                f"{self.queue_capacity}"
+            )
+        if self.total_blocks < 1:
+            raise ValueError(
+                f"serving.blocks_budget must be >= 1, got "
+                f"{self.total_blocks}"
+            )
+
+    def bucket_for(self, prompt_len: int) -> int:
+        for b in self.prefill_buckets:
+            if prompt_len <= b:
+                return b
+        raise ValueError(
+            f"prompt_len={prompt_len} exceeds the largest prefill bucket "
+            f"{self.prefill_buckets[-1]} (serving.max_seq={self.max_seq})"
+        )
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
+        fields = {}
+        for k in ("max_batch", "block_size", "max_seq", "queue_capacity",
+                  "blocks_budget", "hbm_budget_gb"):
+            if k in d:
+                fields[k] = d[k]
+        if "prefill_buckets" in d:
+            fields["prefill_buckets"] = tuple(d["prefill_buckets"])
+        return cls(**fields)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "max_batch": self.max_batch,
+            "block_size": self.block_size,
+            "max_seq": self.max_seq,
+            "num_blocks": self.num_blocks,
+            "prefill_buckets": list(self.prefill_buckets),
+            "queue_capacity": self.queue_capacity,
+            "blocks_budget": self.total_blocks,
+            "hbm_budget_gb": self.hbm_budget_gb,
+        }
+
+
+# ---------------------------------------------------------------------------
+# device programs
+# ---------------------------------------------------------------------------
+
+
+def _split_qkv(qkv: jax.Array, config: ModelConfig):
+    """[..., qkv_width] -> q [..., H], k/v [..., kv_heads * head_dim]."""
+    h, kvd = config.hidden_size, config.kv_heads * config.head_dim
+    return qkv[..., :h], qkv[..., h:h + kvd], qkv[..., h + kvd:]
+
+
+def _serve_block(h, layer, config: ModelConfig, attention_step,
+                 k_l, v_l):
+    """One transformer block with a pluggable attention step — the ONE
+    copy of the ln1/qkv/out/ln2/ffn structure both serving programs
+    share (the serving twin of ``transformer._block``, whose math the
+    equivalence tests pin it against).  ``attention_step(q, k, v, k_l,
+    v_l) -> (attn [B, S, n*d], k_l, v_l)`` owns everything that differs
+    between prefill (dense causal + block write) and decode (cached
+    append + length-masked read)."""
+    y = _layernorm(h, layer["ln1"]["scale"], layer["ln1"]["bias"])
+    qkv = y @ layer["qkv"]["kernel"] + layer["qkv"]["bias"]
+    q, k, v = _split_qkv(qkv, config)
+    attn, k_l, v_l = attention_step(q, k, v, k_l, v_l)
+    h = attn @ layer["out"]["kernel"] + layer["out"]["bias"] + h
+    residual = h
+    y2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
+    y2 = y2 @ layer["ffn_up"]["kernel"] + layer["ffn_up"]["bias"]
+    y2 = jax.nn.gelu(y2)
+    h = (y2 @ layer["ffn_down"]["kernel"]
+         + layer["ffn_down"]["bias"] + residual)
+    return h, (k_l, v_l)
+
+
+def _heads(t: jax.Array, nh: int, d: int) -> jax.Array:
+    """[B, S, nh*d] -> [B, nh, S, d]."""
+    b, s, _ = t.shape
+    return t.reshape(b, s, nh, d).transpose(0, 2, 1, 3)
+
+
+def _cached_attention(q: jax.Array, k_flat: jax.Array, v_flat: jax.Array,
+                      valid: jax.Array) -> jax.Array:
+    """Length-masked decode attention over the flattened cache.
+
+    q: ``[B, n, 1, d]``; k_flat/v_flat: ``[B, S_max, kvh, d]``;
+    valid: ``[B, S_max]`` bool.  Same math as
+    ``models.attention.dense_attention`` (fp32 softmax, 1/sqrt(d),
+    grouped-query einsum broadcasting) with the causal mask replaced by
+    the per-slot validity mask — positions past a slot's length
+    contribute exactly zero (softmax of -inf)."""
+    b, n, _, d = q.shape
+    kvh = k_flat.shape[2]
+    q32 = q.astype(jnp.float32)
+    k32 = k_flat.transpose(0, 2, 1, 3).astype(jnp.float32)  # [B, kvh, S, d]
+    v32 = v_flat.transpose(0, 2, 1, 3).astype(jnp.float32)
+    if kvh != n:
+        q32 = q32.reshape(b, kvh, n // kvh, 1, d)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", q32, k32) / math.sqrt(d)
+        logits = jnp.where(valid[:, None, None, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v32)
+        out = out.reshape(b, n, 1, d)
+    else:
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q32, k32) / math.sqrt(d)
+        logits = jnp.where(valid[:, None, None, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bnqk,bnkd->bnqd", probs, v32)
+    return out.astype(k_flat.dtype)
+
+
+def _write_prompt_blocks(cache_layer: jax.Array, update: jax.Array,
+                         slot: jax.Array) -> jax.Array:
+    """Masked-select write of a prefill bucket into one slot's blocks.
+
+    cache_layer: ``[B, nb, bs, kvh, d]``; update: ``[wb, bs, kvh, d]``
+    (``wb`` = bucket/block_size, static).  One-hot over the slot dim and
+    a static block mask — pure elementwise, so GSPMD keeps the write
+    local to the shard owning the slot (no collective, no regather)."""
+    b_dim, nb = cache_layer.shape[:2]
+    wb = update.shape[0]
+    padded = jnp.pad(update, ((0, nb - wb), (0, 0), (0, 0), (0, 0)))
+    slot_mask = (jnp.arange(b_dim) == slot)[:, None, None, None, None]
+    blk_mask = (jnp.arange(nb) < wb)[None, :, None, None, None]
+    return jnp.where(slot_mask & blk_mask, padded[None], cache_layer)
+
+
+def build_prefill(config: ModelConfig, mesh: Mesh):
+    """Jitted ``prefill(cache, params, x, slot, length) -> (cache,
+    y_last)`` — retraces once per prompt bucket (x's static shape).  The
+    cache is donated (argnum 0), so the carried protocol matches the
+    train-step convention the audit and calibration understand."""
+    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+
+    def prefill(cache: KVCache, params, x, slot, length):
+        bs = cache.block_size
+        s_bucket = x.shape[1]
+        wb = s_bucket // bs
+
+        def attention_step(q, k, v, k_l, v_l):
+            qh, kh, vh = (_heads(q, n, d), _heads(k, kvh, d),
+                          _heads(v, kvh, d))
+            attn = dense_attention(qh, kh, vh, causal=config.causal)
+            # write this layer's K/V blocks into the slot ([S, kvh, d]
+            # token-major, re-tiled to whole blocks)
+            k_blocks = kh.transpose(0, 2, 1, 3)[0].reshape(wb, bs, kvh, d)
+            v_blocks = vh.transpose(0, 2, 1, 3)[0].reshape(wb, bs, kvh, d)
+            k_l = _write_prompt_blocks(k_l, k_blocks, slot)
+            v_l = _write_prompt_blocks(v_l, v_blocks, slot)
+            return (attn.transpose(0, 2, 1, 3).reshape(1, s_bucket, n * d),
+                    k_l, v_l)
+
+        def body(h, layer_and_cache):
+            layer, k_l, v_l = layer_and_cache
+            return _serve_block(h, layer, config, attention_step,
+                                k_l, v_l)
+
+        h, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["layers"], cache.k, cache.v)
+        )
+        y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        y_last = jax.lax.dynamic_slice(
+            y, (0, length - 1, 0), (1, 1, y.shape[-1])
+        )[0, 0]
+        lengths = jnp.where(jnp.arange(cache.max_batch) == slot,
+                            length, cache.lengths).astype(jnp.int32)
+        return KVCache(k_new, v_new, lengths), y_last
+
+    return jax.jit(
+        prefill,
+        donate_argnums=(0,),
+        out_shardings=(cache_shardings(mesh), NamedSharding(mesh, P())),
+    )
+
+
+def decode_batch_spec(mesh: Mesh) -> P:
+    """Decode activations ``[max_batch, 1, H]``: slots over dp."""
+    axes = getattr(mesh, "axis_names", ())
+    dp = "dp" if "dp" in axes and mesh.shape["dp"] > 1 else None
+    return P(dp, None, None)
+
+
+def build_decode_step(config: ModelConfig, mesh: Mesh):
+    """Jitted ``decode_step(carry, params, active) -> (carry, y)`` with
+    ``carry = (cache, x)`` — ONE fixed-shape compile for the whole run.
+    The carry is donated; its returned ``x`` is this step's output, so
+    the engine (and the calibration harness's carry protocol) feeds
+    ``out[0]`` straight back in."""
+    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+
+    def decode_step(carry, params, active):
+        cache, x = carry
+        b_dim, s_max = cache.max_batch, cache.max_seq
+        nb, bs = cache.num_blocks, cache.block_size
+        lengths = cache.lengths
+        pos = jnp.arange(s_max)[None, :]
+        write_mask = (pos == lengths[:, None]) & active[:, None]
+        valid = pos <= lengths[:, None]
+
+        def attention_step(q, k, v, k_l, v_l):
+            qh = _heads(q, n, d)                        # [B, n, 1, d]
+            k_new = k[:, 0].reshape(b_dim, kvh, d)
+            v_new = v[:, 0].reshape(b_dim, kvh, d)
+            # append at each active slot's own length (masked select —
+            # elementwise, shard-local; see serve/kvcache.py)
+            k_flat = k_l.reshape(b_dim, s_max, kvh, d)
+            v_flat = v_l.reshape(b_dim, s_max, kvh, d)
+            k_flat = jnp.where(write_mask[..., None, None],
+                               k_new[:, None], k_flat)
+            v_flat = jnp.where(write_mask[..., None, None],
+                               v_new[:, None], v_flat)
+            attn = _cached_attention(qh, k_flat, v_flat, valid)
+            return (attn.transpose(0, 2, 1, 3).reshape(b_dim, 1, n * d),
+                    k_flat.reshape(b_dim, nb, bs, kvh, d),
+                    v_flat.reshape(b_dim, nb, bs, kvh, d))
+
+        def body(h, layer_and_cache):
+            layer, k_l, v_l = layer_and_cache
+            return _serve_block(h, layer, config, attention_step,
+                                k_l, v_l)
+
+        h, (k_new, v_new) = jax.lax.scan(
+            body, x, (params["layers"], cache.k, cache.v)
+        )
+        y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        lengths = lengths + active.astype(jnp.int32)
+        new_cache = KVCache(k_new, v_new, lengths)
+        return (new_cache, y), y
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    return jax.jit(
+        decode_step,
+        donate_argnums=(0,),
+        out_shardings=((cache_shardings(mesh), x_sh), x_sh),
+    )
+
+
+def _inject_token(carry, slot, vec):
+    """Place a freshly-prefilled request's first token into the decode
+    input buffer: ``x[slot, 0] = vec``."""
+    cache, x = carry
+    mask = (jnp.arange(x.shape[0]) == slot)[:, None, None]
+    return cache, jnp.where(mask, vec[None, None, :].astype(x.dtype), x)
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _SlotState:
+    req: Request
+    tokens_done: int = 0
+    admitted_s: float = 0.0
+    first_token_s: float = 0.0
+
+
+@dataclass
+class _RunStats:
+    ttft_s: list[float] = field(default_factory=list)
+    per_token_s: list[float] = field(default_factory=list)
+    prefill_s: list[float] = field(default_factory=list)
+    decode_step_s: list[float] = field(default_factory=list)
+    e2e_latency_s: list[float] = field(default_factory=list)
+    completed_output_tokens: int = 0
+    generated_tokens: int = 0
+    decode_steps: int = 0
+
+
+class ServingEngine:
+    """Trace-driven continuous-batching engine (see module docstring).
+
+    One engine serves many traces: each :meth:`run_trace` starts from a
+    fresh cache.  The journal (``resilience.journal.SweepJournal``) and
+    metrics registry are optional — the bench harness wires both."""
+
+    def __init__(
+        self,
+        config: ModelConfig,
+        serving: ServingConfig,
+        mesh: Mesh,
+        params: Any = None,
+        journal: Any = None,
+        registry: Optional[MetricsRegistry] = None,
+        seed: int = 0,
+        verbose: bool = True,
+    ) -> None:
+        axes = mesh.axis_names
+        self.dp = mesh.shape["dp"] if "dp" in axes else 1
+        self.tp = mesh.shape["tp"] if "tp" in axes else 1
+        serving.validate(config, dp=self.dp, tp=self.tp)
+        self.config = config
+        self.serving = serving
+        self.mesh = mesh
+        self.verbose = verbose
+        # public and reassignable: the bench wires one journal per run
+        # directory; tests swap it between run_trace calls
+        self.journal = journal
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._requests = self.registry.labeled_counter(
+            "serve_requests", "outcome",
+            initial=("arrived", "admitted", "rejected", "completed"),
+            help="request lifecycle outcomes",
+        )
+        self._dtype = _dtype_of(config.dtype)
+        self.params = (params if params is not None
+                       else init_params_sharded(config, jax.random.key(seed),
+                                                mesh))
+        self._prefill = build_prefill(config, mesh)
+        self._decode = build_decode_step(config, mesh)
+        self._inject = jax.jit(_inject_token, donate_argnums=(0,))
+        self._x_sharding = NamedSharding(mesh, decode_batch_spec(mesh))
+        self._active_sharding = NamedSharding(mesh, P())
+        self._t0 = time.perf_counter()
+
+    # -- clock (monotonic, run-relative) -----------------------------------
+
+    def _now(self) -> float:
+        return time.perf_counter() - self._t0
+
+    # -- setup -------------------------------------------------------------
+
+    def _fresh_carry(self) -> tuple[KVCache, jax.Array]:
+        cache = create_kv_cache(
+            self.config, self.serving.max_batch, self.serving.num_blocks,
+            self.serving.block_size, mesh=self.mesh,
+        )
+        x = jax.device_put(
+            jnp.zeros((self.serving.max_batch, 1, self.config.hidden_size),
+                      self._dtype),
+            self._x_sharding,
+        )
+        return (cache, x)
+
+    def _validate_trace(self, trace: TrafficTrace) -> None:
+        """Fail BEFORE the run on any request the config cannot serve —
+        an infeasible request rejected mid-trace would read as load."""
+        max_bucket = self.serving.prefill_buckets[-1]
+        ledger_cap = self.serving.total_blocks
+        for r in trace:
+            if r.output_len < 1:
+                raise ValueError(
+                    f"request {r.rid}: output_len must be >= 1 "
+                    f"(got {r.output_len})"
+                )
+            if r.prompt_len < 1 or r.prompt_len > max_bucket:
+                raise ValueError(
+                    f"request {r.rid}: prompt_len={r.prompt_len} outside "
+                    f"(0, {max_bucket}] (largest prefill bucket)"
+                )
+            if r.total_tokens > self.serving.max_seq:
+                raise ValueError(
+                    f"request {r.rid}: prompt+output={r.total_tokens} "
+                    f"exceeds serving.max_seq={self.serving.max_seq} "
+                    "(per-slot cache capacity)"
+                )
+            need = max(1, math.ceil(r.total_tokens
+                                    / self.serving.block_size))
+            if need > ledger_cap:
+                raise ValueError(
+                    f"request {r.rid}: needs {need} cache blocks, budget "
+                    f"is {ledger_cap} (serving.blocks_budget)"
+                )
+
+    def _compile(self, buckets: list[int]) -> None:
+        """Warm every jit the trace will hit (prefill per bucket, decode,
+        inject) on scratch state, so compile time never lands in TTFT."""
+        carry = self._fresh_carry()
+        active = jax.device_put(
+            jnp.zeros((self.serving.max_batch,), bool),
+            self._active_sharding,
+        )
+        for b in buckets:
+            dummy = request_embeddings(0, b, self.config.hidden_size,
+                                       dtype=self._dtype, pad_to=b)
+            cache, y_last = self._prefill(
+                carry[0], self.params, dummy, np.int32(0), np.int32(b))
+            carry = (cache, carry[1])
+        carry = self._inject(carry, np.int32(0), y_last)
+        carry, y = self._decode(carry, self.params, active)
+        jax.block_until_ready(y)
+
+    def _event(self, event: str, rid: int, **extra: Any) -> None:
+        if self.journal is not None:
+            self.journal.event(event, config=f"request-{rid}", **extra)
+
+    # -- the run -----------------------------------------------------------
+
+    def run_trace(self, trace: TrafficTrace) -> dict[str, Any]:
+        """Serve ``trace`` to completion; returns the report dict
+        (``docs/serving.md`` documents every field).  Pure compute + host
+        scheduling — writing artifacts is ``serve/bench.py``'s job."""
+        if not len(trace):
+            raise ValueError("cannot serve an empty trace")
+        self._validate_trace(trace)
+        cfg = self.serving
+        buckets = sorted({cfg.bucket_for(r.prompt_len) for r in trace})
+        with Timer() as t_compile:
+            self._compile(buckets)
+        compile_time = t_compile.elapsed
+
+        ledger = BlockLedger(cfg.total_blocks, cfg.block_size)
+        # registry counters are cumulative across an engine's lifetime
+        # (Prometheus semantics); the report carries THIS run's deltas
+        counts_base = {k: self._requests[k] for k in self._requests}
+        pending = deque(sorted(trace, key=lambda r: (r.arrival_s, r.rid)))
+        queue: deque[Request] = deque()
+        slots: dict[int, _SlotState] = {}
+        free_slots = list(range(cfg.max_batch))
+        stats = _RunStats()
+        series: dict[str, list] = {
+            "t_s": [], "queue_depth": [], "active_slots": [],
+            "blocks_in_use": [], "blocks_reserved": [],
+        }
+        carry = self._fresh_carry()
+        active_np = np.zeros((cfg.max_batch,), bool)
+        active_dev = jax.device_put(jnp.asarray(active_np),
+                                    self._active_sharding)
+        rejected_detail: list[int] = []
+
+        def refresh_active() -> None:
+            nonlocal active_dev
+            active_dev = jax.device_put(jnp.asarray(active_np),
+                                        self._active_sharding)
+
+        def complete(slot: int) -> None:
+            st = slots.pop(slot)
+            ledger.free(slot)
+            active_np[slot] = False
+            free_slots.append(slot)
+            free_slots.sort()
+            done_at = self._now()
+            stats.e2e_latency_s.append(done_at - st.req.arrival_s)
+            stats.completed_output_tokens += st.req.output_len
+            self._requests["completed"] += 1
+            self._event("request-completed", st.req.rid,
+                        output_tokens=st.req.output_len,
+                        latency_s=round(done_at - st.req.arrival_s, 6))
+
+        self._t0 = time.perf_counter()
+        while pending or queue or slots:
+            now = self._now()
+            # 1. arrivals -> admission control (bounded queue)
+            while pending and pending[0].arrival_s <= now:
+                req = pending.popleft()
+                self._requests["arrived"] += 1
+                self._event("request-arrived", req.rid,
+                            prompt=req.prompt_len, output=req.output_len)
+                if len(queue) >= cfg.queue_capacity:
+                    self._requests["rejected"] += 1
+                    rejected_detail.append(req.rid)
+                    self._event("request-rejected", req.rid,
+                                reason="queue-full",
+                                queue_depth=len(queue))
+                else:
+                    queue.append(req)
+                    self._requests["admitted"] += 1
+                    self._event("request-admitted", req.rid,
+                                queue_depth=len(queue))
+            # 2. step-boundary scheduling: grant slots + block
+            #    reservations, prefill each granted request
+            scheduled = False
+            if queue and free_slots:
+                with spans.span("serve-admission", queue=len(queue),
+                                free_slots=len(free_slots)):
+                    while (queue and free_slots
+                            and ledger.can_reserve(queue[0].total_tokens)):
+                        req = queue.popleft()
+                        slot = free_slots.pop(0)
+                        ledger.reserve(slot, req.total_tokens)
+                        bucket = cfg.bucket_for(req.prompt_len)
+                        x_prompt = request_embeddings(
+                            req.seed, req.prompt_len,
+                            self.config.hidden_size, dtype=self._dtype,
+                            pad_to=bucket,
+                        )
+                        with spans.span("serve-prefill", rid=req.rid,
+                                        bucket=bucket, slot=slot):
+                            t0 = time.perf_counter()
+                            cache, y_last = self._prefill(
+                                carry[0], self.params, x_prompt,
+                                np.int32(slot), np.int32(req.prompt_len))
+                            jax.block_until_ready(y_last)
+                            dt = time.perf_counter() - t0
+                        carry = self._inject((cache, carry[1]),
+                                             np.int32(slot), y_last)
+                        ledger.append(slot, req.prompt_len)
+                        t_first = self._now()
+                        st = _SlotState(req=req, tokens_done=1,
+                                        admitted_s=now,
+                                        first_token_s=t_first)
+                        slots[slot] = st
+                        active_np[slot] = True
+                        stats.ttft_s.append(t_first - req.arrival_s)
+                        stats.prefill_s.append(dt)
+                        stats.generated_tokens += 1
+                        scheduled = True
+                        self._event("request-prefill", req.rid, slot=slot,
+                                    bucket=bucket,
+                                    ttft_s=round(t_first - req.arrival_s, 6))
+                        if st.tokens_done >= req.output_len:
+                            complete(slot)
+                if scheduled:
+                    refresh_active()
+            # 3. one continuous-batching decode step over every resident
+            #    request
+            if slots:
+                with spans.span("serve-decode", active=len(slots)):
+                    t0 = time.perf_counter()
+                    carry, y = self._decode(carry, self.params, active_dev)
+                    jax.block_until_ready(y)
+                    dt = time.perf_counter() - t0
+                stats.decode_step_s.append(dt)
+                stats.decode_steps += 1
+                finished = []
+                for slot in sorted(slots):
+                    st = slots[slot]
+                    st.tokens_done += 1
+                    ledger.append(slot, 1)
+                    stats.per_token_s.append(dt)
+                    stats.generated_tokens += 1
+                    if st.tokens_done >= st.req.output_len:
+                        finished.append(slot)
+                for slot in finished:
+                    complete(slot)
+                if finished:
+                    refresh_active()
+            elif pending and not queue:
+                # idle until the next arrival (nothing resident, nothing
+                # admittable)
+                wait = pending[0].arrival_s - self._now()
+                if wait > 0:
+                    time.sleep(min(wait, 0.05))
+            # 4. timeseries sample at the step boundary
+            series["t_s"].append(round(self._now(), 6))
+            series["queue_depth"].append(len(queue))
+            series["active_slots"].append(len(slots))
+            series["blocks_in_use"].append(ledger.blocks_in_use)
+            series["blocks_reserved"].append(ledger.blocks_reserved)
+            self.registry.set_gauge("serve_queue_depth", len(queue),
+                                    help="bounded admission queue depth")
+            self.registry.set_gauge("serve_active_slots", len(slots),
+                                    help="decode slots in use")
+            self.registry.set_gauge("serve_cache_blocks_in_use",
+                                    ledger.blocks_in_use,
+                                    help="cache blocks holding tokens")
+        wall = self._now()
+
+        self.registry.set_gauge("serve_queue_depth_peak",
+                                max(series["queue_depth"], default=0))
+        self.registry.set_gauge("serve_cache_blocks_peak",
+                                ledger.peak_in_use)
+        goodput = (stats.completed_output_tokens / wall) if wall > 0 else 0.0
+        report = {
+            "schema": SERVING_REPORT_SCHEMA,
+            "model": {
+                "hidden_size": self.config.hidden_size,
+                "num_layers": self.config.num_layers,
+                "num_heads": self.config.num_heads,
+                "kv_heads": self.config.kv_heads,
+                "attention": self.config.attention,
+                "dtype": self.config.dtype,
+            },
+            "mesh": {"dp": self.dp, "tp": self.tp},
+            "serving": cfg.to_dict(),
+            "trace": {
+                "kind": trace.kind,
+                "seed": trace.seed,
+                "num_requests": len(trace),
+                "params": dict(trace.params),
+                "horizon_s": trace.horizon_s,
+            },
+            "requests": {
+                **{k: self._requests[k] - counts_base[k]
+                   for k in ("arrived", "admitted", "rejected",
+                             "completed")},
+                "rejected_rids": rejected_detail,
+            },
+            "goodput_tokens_per_s": goodput,
+            "throughput_tokens_per_s": (
+                stats.generated_tokens / wall if wall > 0 else 0.0
+            ),
+            "completed_output_tokens": stats.completed_output_tokens,
+            "generated_tokens": stats.generated_tokens,
+            "decode_steps": stats.decode_steps,
+            "ttft": summarize(stats.ttft_s),
+            "per_token_latency": summarize(stats.per_token_s),
+            "e2e_latency": summarize(stats.e2e_latency_s),
+            "prefill_time": summarize(stats.prefill_s),
+            "decode_step_time": summarize(stats.decode_step_s),
+            "cache": ledger.stats(),
+            "timeseries": series,
+            "compile_time_s": compile_time,
+            "wall_seconds": wall,
+        }
+        if self.verbose:
+            ttft = report["ttft"]
+            ptl = report["per_token_latency"]
+            print(
+                f"[serve] {trace.kind} x{len(trace)}: "
+                f"{report['requests']['completed']} completed / "
+                f"{report['requests']['rejected']} rejected, "
+                f"goodput {goodput:.0f} tok/s, "
+                f"ttft p50 {ttft['median'] * 1e3:.1f} ms "
+                f"p99 {ttft['p99'] * 1e3:.1f} ms, "
+                f"per-token p50 {ptl['median'] * 1e3:.2f} ms"
+            )
+        return report

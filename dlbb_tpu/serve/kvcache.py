@@ -1,0 +1,208 @@
+"""Paged KV-cache: an explicit, mesh-sharded pytree + host block ledger.
+
+Device side, the cache is a :class:`KVCache` NamedTuple (automatically a
+JAX pytree) of fixed-shape arrays — jit-stable across the whole serving
+run:
+
+- ``k``/``v``: ``[L, max_batch, num_blocks, block_size, kv_heads,
+  head_dim]`` — every layer, every decode *slot*, the slot's block ring.
+  GQA-aware: K/V are stored at ``kv_heads`` width (never broadcast to
+  ``num_heads``).  Sharded per the :class:`~dlbb_tpu.parallel.plan.
+  ParallelismPlan`: the slot (batch) dim over ``dp``, the kv-head dim
+  over ``tp`` — the same Megatron split the QKV projection produces, so
+  cache writes and decode reads are shard-local and the audit's byte
+  ceiling can prove no step ever re-gathers the cache
+  (``docs/serving.md``).
+- ``lengths``: ``[max_batch] int32``, tokens currently valid per slot —
+  replicated (tiny; every shard needs it to build attention masks).
+
+Writes are pure masked selects (one-hot over the slot / flat-position
+dim), never gather/scatter with cross-shard indices — elementwise ops
+GSPMD partitions without inserting a single collective.  XLA turns them
+into in-place updates because every step donates the cache.
+
+Host side, :class:`BlockLedger` does the alloc/free/append accounting
+against a global block budget: admission *reserves* a request's
+worst-case blocks (``ceil((prompt+output)/block_size)``) so a trace can
+never OOM the cache mid-run (the build-time HBM gate is
+``models.configs.validate_serving``), appends track blocks actually
+holding tokens (the occupancy the report plots), and completion frees
+both.  The ledger raising on over-use is a *bug* invariant, not a load
+condition — reservation-based admission makes it unreachable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dlbb_tpu.models.configs import ModelConfig
+
+
+class KVCache(NamedTuple):
+    """The device half of the paged cache (see module docstring)."""
+
+    k: jax.Array        # [L, max_batch, num_blocks, block_size, kvh, d]
+    v: jax.Array        # same
+    lengths: jax.Array  # [max_batch] int32
+
+    @property
+    def max_batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_seq(self) -> int:
+        return self.num_blocks * self.block_size
+
+
+def cache_specs(mesh: Optional[Mesh]) -> KVCache:
+    """PartitionSpecs matching :class:`KVCache`'s structure for ``mesh``:
+    slot dim over ``dp``, kv-head dim over ``tp`` (each only when the
+    mesh has that axis with size > 1); lengths replicated."""
+    axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+    dp = "dp" if "dp" in axes and mesh.shape["dp"] > 1 else None
+    tp = "tp" if "tp" in axes and mesh.shape["tp"] > 1 else None
+    kv_spec = P(None, dp, None, None, tp, None)
+    return KVCache(k=kv_spec, v=kv_spec, lengths=P(None))
+
+
+def cache_shardings(mesh: Mesh) -> KVCache:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), cache_specs(mesh),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def create_kv_cache(
+    config: ModelConfig,
+    max_batch: int,
+    num_blocks: int,
+    block_size: int,
+    mesh: Optional[Mesh] = None,
+) -> KVCache:
+    """Zero-initialised cache, created *directly sharded* onto the mesh
+    (jit with explicit out-shardings — same trick as
+    ``init_params_sharded``: no device ever holds the replicated cache)."""
+    from dlbb_tpu.models.transformer import _dtype_of
+
+    dtype = _dtype_of(config.dtype)
+    shape = (config.num_layers, max_batch, num_blocks, block_size,
+             config.kv_heads, config.head_dim)
+
+    def build() -> KVCache:
+        return KVCache(
+            k=jnp.zeros(shape, dtype),
+            v=jnp.zeros(shape, dtype),
+            lengths=jnp.zeros((max_batch,), jnp.int32),
+        )
+
+    if mesh is None:
+        return build()
+    return jax.jit(build, out_shardings=cache_shardings(mesh))()
+
+
+class CacheOverflow(RuntimeError):
+    """A slot used more blocks than were reserved for it — an engine bug
+    (reservation-based admission makes this unreachable under load)."""
+
+
+class BlockLedger:
+    """Host-side alloc/free/append accounting for the block pool.
+
+    ``total_blocks`` is the global budget (defaults to the physical pool,
+    ``max_batch * num_blocks``; configurable lower to model cache
+    pressure).  Reservation is all-or-nothing per request; ``append``
+    moves a block from reserved to in-use when a token crosses a block
+    boundary; ``free`` returns everything."""
+
+    def __init__(self, total_blocks: int, block_size: int) -> None:
+        if total_blocks < 1 or block_size < 1:
+            raise ValueError(
+                f"ledger needs positive sizes (total_blocks="
+                f"{total_blocks}, block_size={block_size})"
+            )
+        self.total_blocks = total_blocks
+        self.block_size = block_size
+        self._reserved: dict[int, int] = {}   # slot -> blocks reserved
+        self._tokens: dict[int, int] = {}     # slot -> tokens appended
+        self.peak_reserved = 0
+        self.peak_in_use = 0
+
+    def blocks_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.block_size))
+
+    @property
+    def blocks_reserved(self) -> int:
+        return sum(self._reserved.values())
+
+    @property
+    def blocks_in_use(self) -> int:
+        return sum(self.blocks_for(t) if t else 0
+                   for t in self._tokens.values())
+
+    @property
+    def blocks_free(self) -> int:
+        return self.total_blocks - self.blocks_reserved
+
+    def can_reserve(self, total_tokens: int) -> bool:
+        return self.blocks_for(total_tokens) <= self.blocks_free
+
+    def reserve(self, slot: int, total_tokens: int) -> int:
+        """Reserve a request's worst-case blocks for ``slot``; returns the
+        count.  Raises when the slot is already occupied or the budget
+        cannot cover it (callers gate on :meth:`can_reserve`)."""
+        if slot in self._reserved:
+            raise CacheOverflow(f"slot {slot} already holds a reservation")
+        need = self.blocks_for(total_tokens)
+        if need > self.blocks_free:
+            raise CacheOverflow(
+                f"cannot reserve {need} blocks for slot {slot}: only "
+                f"{self.blocks_free}/{self.total_blocks} free"
+            )
+        self._reserved[slot] = need
+        self._tokens[slot] = 0
+        self.peak_reserved = max(self.peak_reserved, self.blocks_reserved)
+        return need
+
+    def append(self, slot: int, tokens: int = 1) -> None:
+        """Account ``tokens`` written into ``slot`` (prefill passes the
+        prompt length, decode passes 1)."""
+        if slot not in self._reserved:
+            raise CacheOverflow(f"append to unreserved slot {slot}")
+        self._tokens[slot] += tokens
+        if self.blocks_for(self._tokens[slot]) > self._reserved[slot]:
+            raise CacheOverflow(
+                f"slot {slot} outgrew its reservation "
+                f"({self._tokens[slot]} tokens > "
+                f"{self._reserved[slot]} blocks x {self.block_size})"
+            )
+        self.peak_in_use = max(self.peak_in_use, self.blocks_in_use)
+
+    def free(self, slot: int) -> int:
+        """Release a slot's reservation; returns the blocks returned."""
+        if slot not in self._reserved:
+            raise CacheOverflow(f"free of unreserved slot {slot}")
+        blocks = self._reserved.pop(slot)
+        self._tokens.pop(slot)
+        return blocks
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "total_blocks": self.total_blocks,
+            "blocks_reserved": self.blocks_reserved,
+            "blocks_in_use": self.blocks_in_use,
+            "peak_blocks_reserved": self.peak_reserved,
+            "peak_blocks_in_use": self.peak_in_use,
+        }

@@ -1,0 +1,238 @@
+"""Synthetic serving traffic: seeded, replayable request traces.
+
+The serving benchmark (``serve/bench.py``) is trace-driven: a
+:class:`TrafficTrace` fixes every request's arrival time, prompt length,
+output length, and embedding seed up front, so a run is exactly
+reproducible (same trace + same engine config => same admission order,
+same rejections, same token counts) and two engine configurations can be
+compared on *identical* load.  Three arrival processes model the
+"millions of users" regimes the ROADMAP north-star cares about:
+
+- ``poisson``  — homogeneous Poisson arrivals (exponential inter-arrival
+  times at ``rate`` req/s): the steady-state baseline.
+- ``bursty``   — a 2-state Markov-modulated Poisson process (MMPP):
+  exponentially-distributed dwells in a ``calm`` state at ``rate`` and a
+  ``burst`` state at ``rate * burst_factor``.  Bursts are what stress
+  admission control and the bounded queue.
+- ``diurnal``  — a nonhomogeneous Poisson process with a sinusoidal rate
+  profile ``rate * (1 + depth * sin(2*pi*t/period))``, sampled by
+  Lewis-Shedler thinning: the compressed day/night cycle.
+
+Prompt/output lengths are sampled from clipped lognormals (long-tailed,
+like real chat traffic) inside caller-given bounds; each request carries
+its own embedding seed for :func:`dlbb_tpu.data.synthetic.
+request_embeddings`.  Traces serialise to JSON (schema
+``dlbb_serving_trace_v1``, documented in ``docs/serving.md``) through the
+repo's atomic writer.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Any, Iterator, Optional
+
+import numpy as np
+
+TRACE_SCHEMA = "dlbb_serving_trace_v1"
+
+TRACE_KINDS = ("poisson", "bursty", "diurnal")
+
+
+@dataclass(frozen=True)
+class Request:
+    """One serving request, fully determined at trace-generation time.
+
+    arrival_s is relative to the start of the run (the engine's
+    monotonic clock); ``seed`` derives the request's synthetic prompt
+    embeddings, so replaying a trace replays the exact inputs."""
+
+    rid: int
+    arrival_s: float
+    prompt_len: int
+    output_len: int
+    seed: int
+
+    @property
+    def total_tokens(self) -> int:
+        return self.prompt_len + self.output_len
+
+
+@dataclass(frozen=True)
+class TrafficTrace:
+    """A replayable request trace (sorted by arrival time)."""
+
+    kind: str
+    seed: int
+    params: dict[str, Any]
+    requests: tuple[Request, ...] = field(default_factory=tuple)
+
+    def __iter__(self) -> Iterator[Request]:
+        return iter(self.requests)
+
+    def __len__(self) -> int:
+        return len(self.requests)
+
+    @property
+    def horizon_s(self) -> float:
+        """Arrival time of the last request (0 for an empty trace)."""
+        return self.requests[-1].arrival_s if self.requests else 0.0
+
+    @property
+    def max_total_tokens(self) -> int:
+        return max((r.total_tokens for r in self.requests), default=0)
+
+    @property
+    def max_prompt_len(self) -> int:
+        return max((r.prompt_len for r in self.requests), default=0)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "schema": TRACE_SCHEMA,
+            "kind": self.kind,
+            "seed": self.seed,
+            "params": dict(self.params),
+            "requests": [asdict(r) for r in self.requests],
+        }
+
+    def save(self, path: "str | Path") -> Path:
+        from dlbb_tpu.utils.config import atomic_write_text
+
+        return atomic_write_text(json.dumps(self.to_dict(), indent=2),
+                                 Path(path))
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "TrafficTrace":
+        if d.get("schema") != TRACE_SCHEMA:
+            raise ValueError(
+                f"not a serving trace (schema={d.get('schema')!r}, "
+                f"expected {TRACE_SCHEMA!r})"
+            )
+        reqs = tuple(Request(**r) for r in d["requests"])
+        return cls(kind=d["kind"], seed=int(d["seed"]),
+                   params=dict(d.get("params", {})), requests=reqs)
+
+    @classmethod
+    def load(cls, path: "str | Path") -> "TrafficTrace":
+        return cls.from_dict(json.loads(Path(path).read_text()))
+
+
+def _lognormal_lengths(rng: np.random.Generator, n: int,
+                       lo: int, hi: int) -> np.ndarray:
+    """Clipped-lognormal integer lengths in ``[lo, hi]`` — median near the
+    geometric middle of the range, with the long right tail clipped."""
+    if lo < 1 or lo > hi:
+        raise ValueError(
+            f"length bounds must satisfy 1 <= lo <= hi, got [{lo}, {hi}]"
+        )
+    if lo == hi:
+        return np.full(n, lo, dtype=np.int64)
+    mu = 0.5 * (math.log(lo) + math.log(hi))
+    sigma = (math.log(hi) - math.log(lo)) / 4.0
+    raw = rng.lognormal(mean=mu, sigma=sigma, size=n)
+    return np.clip(np.round(raw).astype(np.int64), lo, hi)
+
+
+def _poisson_arrivals(rng: np.random.Generator, n: int,
+                      rate: float) -> np.ndarray:
+    return np.cumsum(rng.exponential(1.0 / rate, size=n))
+
+
+def _bursty_arrivals(rng: np.random.Generator, n: int, rate: float,
+                     burst_factor: float, dwell_s: float) -> np.ndarray:
+    """2-state MMPP: exponential dwells (mean ``dwell_s``) alternating
+    between ``rate`` and ``rate * burst_factor``."""
+    arrivals = np.empty(n)
+    t = 0.0
+    burst = False
+    state_end = float(rng.exponential(dwell_s))
+    for i in range(n):
+        while True:
+            r = rate * burst_factor if burst else rate
+            gap = float(rng.exponential(1.0 / r))
+            if t + gap <= state_end:
+                t += gap
+                arrivals[i] = t
+                break
+            # the gap straddles a state switch: advance to the boundary
+            # and resample in the new state (memorylessness makes the
+            # truncated draw exact)
+            t = state_end
+            burst = not burst
+            state_end = t + float(rng.exponential(dwell_s))
+    return arrivals
+
+
+def _diurnal_arrivals(rng: np.random.Generator, n: int, rate: float,
+                      period_s: float, depth: float) -> np.ndarray:
+    """Lewis-Shedler thinning of a ``rate * (1 + depth*sin)`` profile."""
+    if not 0.0 <= depth < 1.0:
+        raise ValueError(f"diurnal depth must be in [0, 1), got {depth}")
+    rate_max = rate * (1.0 + depth)
+    arrivals = np.empty(n)
+    t = 0.0
+    i = 0
+    while i < n:
+        t += float(rng.exponential(1.0 / rate_max))
+        lam = rate * (1.0 + depth * math.sin(2.0 * math.pi * t / period_s))
+        if rng.uniform() * rate_max <= lam:
+            arrivals[i] = t
+            i += 1
+    return arrivals
+
+
+def generate_trace(
+    kind: str,
+    num_requests: int,
+    seed: int = 42,
+    rate: float = 32.0,
+    prompt_range: tuple[int, int] = (8, 96),
+    output_range: tuple[int, int] = (4, 48),
+    burst_factor: float = 6.0,
+    dwell_s: float = 0.5,
+    period_s: float = 4.0,
+    depth: float = 0.8,
+) -> TrafficTrace:
+    """Generate a seeded, replayable trace.
+
+    ``rate`` is the mean arrival rate in req/s (the calm-state rate for
+    ``bursty``, the mean of the sinusoid for ``diurnal``); length bounds
+    are inclusive.  The same ``(kind, num_requests, seed, params)``
+    always yields the identical trace.
+    """
+    if kind not in TRACE_KINDS:
+        raise ValueError(
+            f"unknown trace kind {kind!r} (expected one of {TRACE_KINDS})"
+        )
+    if num_requests <= 0:
+        raise ValueError(f"num_requests must be > 0, got {num_requests}")
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0 req/s, got {rate}")
+    rng = np.random.default_rng(seed)
+    if kind == "poisson":
+        arrivals = _poisson_arrivals(rng, num_requests, rate)
+        params: dict[str, Any] = {"rate": rate}
+    elif kind == "bursty":
+        arrivals = _bursty_arrivals(rng, num_requests, rate,
+                                    burst_factor, dwell_s)
+        params = {"rate": rate, "burst_factor": burst_factor,
+                  "dwell_s": dwell_s}
+    else:
+        arrivals = _diurnal_arrivals(rng, num_requests, rate,
+                                     period_s, depth)
+        params = {"rate": rate, "period_s": period_s, "depth": depth}
+    prompts = _lognormal_lengths(rng, num_requests, *prompt_range)
+    outputs = _lognormal_lengths(rng, num_requests, *output_range)
+    seeds = rng.integers(0, 2**31 - 1, size=num_requests)
+    params.update({"prompt_range": list(prompt_range),
+                   "output_range": list(output_range)})
+    requests = tuple(
+        Request(rid=i, arrival_s=float(arrivals[i]),
+                prompt_len=int(prompts[i]), output_len=int(outputs[i]),
+                seed=int(seeds[i]))
+        for i in range(num_requests)
+    )
+    return TrafficTrace(kind=kind, seed=seed, params=params,
+                        requests=requests)

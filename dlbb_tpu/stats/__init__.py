@@ -14,6 +14,7 @@ from dlbb_tpu.stats.stats1d import (
     process_1d_results,
 )
 from dlbb_tpu.stats.stats3d import process_3d_results
+from dlbb_tpu.stats.serving_report import write_serving_report
 
 __all__ = [
     "calculate_statistics",
@@ -21,5 +22,6 @@ __all__ = [
     "process_1d_results",
     "process_3d_results",
     "write_comparison",
+    "write_serving_report",
     "write_variants_report",
 ]

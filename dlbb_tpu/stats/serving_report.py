@@ -1,0 +1,119 @@
+"""Serving-level report: consolidate ``serving_*.json`` results into a
+CSV + markdown table (``SERVING.md``) — the serving analogue of the
+variants/parallelism reports.  Pure file processing, no backend."""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+from dlbb_tpu.utils.config import atomic_write_text
+
+CSV_COLUMNS = (
+    "name", "trace", "requests", "completed", "rejected", "mesh",
+    "max_batch", "block_size", "max_seq",
+    "goodput_tok_s", "throughput_tok_s",
+    "ttft_p50_ms", "ttft_p99_ms", "ttft_p999_ms",
+    "per_token_p50_ms", "per_token_p99_ms", "per_token_p999_ms",
+    "peak_queue_depth", "peak_blocks_in_use", "decode_steps",
+    "wall_seconds",
+)
+
+
+def _ms(summary: dict[str, Any], key: str) -> Optional[float]:
+    v = summary.get(key)
+    return None if v is None else round(float(v) * 1e3, 3)
+
+
+def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
+    """One CSV/markdown row from a serving report JSON."""
+    req = report.get("requests", {})
+    ttft = report.get("ttft", {})
+    ptl = report.get("per_token_latency", {})
+    cache = report.get("cache", {})
+    mesh = report.get("mesh", {})
+    series = report.get("timeseries", {})
+    serving = report.get("serving", {})
+    return {
+        "name": name,
+        "trace": report.get("trace", {}).get("kind"),
+        "requests": report.get("trace", {}).get("num_requests"),
+        "completed": req.get("completed"),
+        "rejected": req.get("rejected"),
+        "mesh": "x".join(f"{k}{v}" for k, v in sorted(mesh.items())
+                         if isinstance(v, int) and v > 1) or "1",
+        "max_batch": serving.get("max_batch"),
+        "block_size": serving.get("block_size"),
+        "max_seq": serving.get("max_seq"),
+        "goodput_tok_s": round(report.get("goodput_tokens_per_s", 0.0), 1),
+        "throughput_tok_s": round(
+            report.get("throughput_tokens_per_s", 0.0), 1),
+        "ttft_p50_ms": _ms(ttft, "median"),
+        "ttft_p99_ms": _ms(ttft, "p99"),
+        "ttft_p999_ms": _ms(ttft, "p999"),
+        "per_token_p50_ms": _ms(ptl, "median"),
+        "per_token_p99_ms": _ms(ptl, "p99"),
+        "per_token_p999_ms": _ms(ptl, "p999"),
+        "peak_queue_depth": max(series.get("queue_depth", [0]) or [0]),
+        "peak_blocks_in_use": cache.get("peak_blocks_in_use"),
+        "decode_steps": report.get("decode_steps"),
+        "wall_seconds": round(report.get("wall_seconds", 0.0), 3),
+    }
+
+
+def write_serving_report(results_dir: "str | Path",
+                         output_dir: "str | Path") -> list[dict[str, Any]]:
+    """Consolidate every ``serving_*.json`` under ``results_dir`` into
+    ``output_dir``'s ``serving.csv`` + ``SERVING.md``.  Returns the rows
+    (empty when there is nothing to report — callers skip, never clobber
+    a committed report with an empty table)."""
+    results_dir = Path(results_dir)
+    rows = []
+    for path in sorted(results_dir.rglob("serving_*.json")):
+        if path.name == "serving_manifest.json":
+            continue
+        try:
+            report = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if report.get("schema", "").startswith("dlbb_serving_report"):
+            rows.append(serving_row(report, path.stem[len("serving_"):]))
+    if not rows:
+        return rows
+    out = Path(output_dir)
+    buf = io.StringIO()
+    writer = csv.DictWriter(buf, fieldnames=CSV_COLUMNS)
+    writer.writeheader()
+    writer.writerows(rows)
+    atomic_write_text(buf.getvalue(), out / "serving.csv", newline="")
+
+    lines = [
+        "# Serving benchmark report",
+        "",
+        "Trace-driven continuous-batching runs "
+        "(`python -m dlbb_tpu.cli serve`, docs/serving.md).  Goodput is "
+        "completed-request output tokens per second; TTFT is "
+        "arrival-to-first-token (queueing included); per-token latency "
+        "is the decode-step interval each resident request observed.",
+        "",
+        "| run | trace | req | done | rej | mesh | goodput tok/s | "
+        "TTFT p50/p99/p99.9 ms | tok p50/p99/p99.9 ms | peak queue | "
+        "peak blocks |",
+        "|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        lines.append(
+            f"| {r['name']} | {r['trace']} | {r['requests']} | "
+            f"{r['completed']} | {r['rejected']} | {r['mesh']} | "
+            f"{r['goodput_tok_s']} | "
+            f"{r['ttft_p50_ms']}/{r['ttft_p99_ms']}/{r['ttft_p999_ms']} | "
+            f"{r['per_token_p50_ms']}/{r['per_token_p99_ms']}/"
+            f"{r['per_token_p999_ms']} | "
+            f"{r['peak_queue_depth']} | {r['peak_blocks_in_use']} |"
+        )
+    lines.append("")
+    atomic_write_text("\n".join(lines), out / "SERVING.md")
+    return rows

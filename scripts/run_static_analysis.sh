@@ -74,6 +74,20 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_collective_matmul.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
     -m chaos_smoke -p no:cacheprovider
 
+# serving smoke (docs/serving.md): a seeded 30-request Poisson
+# mini-trace through the continuous-batching engine on the simulated
+# dp2 x tp4 mesh — zero rejected-by-bug requests (queue capacity covers
+# the whole trace, so any rejection is an engine bug), a schema-valid
+# span-trace file, journaled request lifecycle, metrics.prom export,
+# and the bench artifact set.  The HLO-side serving contract (decode =
+# tiny tp collectives only, activation byte ceiling proving no
+# KV-cache regather, donated cache carry) is enforced by `analyze all`
+# above via the serve/engine.py targets in the default registry, and
+# regression-gated by `analyze diff` against the committed baselines —
+# zero suppressions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
+    -m serve_smoke -p no:cacheprovider
+
 # compressed-collective smoke (docs/compression.md): int8/fp8 allreduce_q
 # mini-sweep through the real engine + one compressed train step whose
 # losses track the uncompressed run — the HLO-side compression proof

@@ -72,6 +72,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "serve_smoke: serving-engine smoke — a seeded 30-request Poisson "
+        "mini-trace through the continuous-batching engine with span "
+        "trace + journal + metrics export (tier-1; also invoked "
+        "standalone by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )
