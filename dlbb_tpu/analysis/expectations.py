@@ -386,6 +386,48 @@ def compressed_op_expectation(op_name: str, p: int, num_elements: int,
     )
 
 
+def decode_scan_expectation(dp: int, tp: int, k: int,
+                            act_bytes: int,
+                            slack: float = 1.25) -> TargetExpectation:
+    """Expectation for the FUSED multi-step decode scan
+    (``serve/engine.py::build_decode_fused``): the scan body may contain
+    only the per-token tp collectives (``plan_expected_kinds(decode=
+    True)``), and — execution-weighted through the scan's
+    ``known_trip_count`` (the while-body pricing the schedule auditor
+    already does) — the row-parallel psum must fire at least once per
+    trip: ``min_required = k``.
+
+    all-gather is additionally allowed for ONE structural artifact:
+    XLA hoists the loop-invariant slot-lengths vector into the while
+    carry, GSPMD shards the hoisted copy over dp, and the final
+    lengths computation re-gathers it at the loop BOUNDARY — a single
+    ``4 B x max_batch`` instruction, executed once per scan (verified
+    against the compiled HLO; the engine already keeps lengths out of
+    the live carry, which removed the per-trip gathers).  The ceiling
+    still prices every instruction at ONE step's activation bytes, so
+    a cache regather — ~8x the ceiling for even one layer's plane —
+    fails the byte axis outright, and its trip-count-weighted wire
+    lands far past the committed baseline's 1.10x ``analyze diff``
+    gate."""
+    return TargetExpectation(
+        allowed=plan_expected_kinds(dp=dp, tp=tp, decode=True)
+        | {"all-gather"},
+        required_any={"all-reduce"},
+        min_required=k,
+        max_bytes_per_instr=int(act_bytes * slack),
+        expect_donation=True,
+    )
+
+
+def compact_expectation() -> TargetExpectation:
+    """Expectation for the slot-compaction gather/scatter jits
+    (``serve/engine.py``): pure LOCAL data movement — the slot dim is
+    unsharded (dp=1 is enforced at config validation), so the lowered
+    program must contain ZERO collectives.  Any collective here means
+    the repack crossed the wire and compaction cannot win."""
+    return TargetExpectation(allowed=set(), required_any=None)
+
+
 def overlap_op_expectation(p: int, chunk_bytes: int,
                            slack: float = 1.25) -> TargetExpectation:
     """Expectation for a RING-DECOMPOSED collective matmul (either op,

@@ -638,10 +638,13 @@ def _compressed_train_target(compression: str = "int8",
 _SERVE_SHAPE = dict(max_batch=4, num_blocks=4, block_size=8, bucket=16)
 
 
-def _serve_build(dp: int, tp: int, what: str):
+def _serve_build(dp: int, tp: int, what: str, k: int = 4):
     """Common builder for the serving targets: engine jits + example
     args on a (dp, tp) mesh — the exact programs ``serve/engine.py``
-    runs, so the audit gates the real decode/prefill lowering."""
+    runs, so the audit gates the real decode/prefill/fast-path
+    lowerings.  ``what`` selects decode / decode_fused / prefill /
+    prefill_chunk / compact_gather / compact_scatter; ``k`` is the
+    fused-scan trip count."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -650,7 +653,15 @@ def _serve_build(dp: int, tp: int, what: str):
     from dlbb_tpu.comm.mesh import build_parallelism_mesh
     from dlbb_tpu.models.configs import ModelConfig
     from dlbb_tpu.models.transformer import init_params_sharded
-    from dlbb_tpu.serve.engine import build_decode_step, build_prefill
+    from dlbb_tpu.serve.engine import (
+        build_compact_gather,
+        build_compact_scatter,
+        build_decode_fused,
+        build_decode_step,
+        build_prefill,
+        build_prefill_chunk,
+        decode_batch_spec,
+    )
     from dlbb_tpu.serve.kvcache import create_kv_cache
 
     cfg = ModelConfig(**_TINY_MODEL)
@@ -660,22 +671,54 @@ def _serve_build(dp: int, tp: int, what: str):
         cfg, _SERVE_SHAPE["max_batch"], _SERVE_SHAPE["num_blocks"],
         _SERVE_SHAPE["block_size"], mesh=mesh,
     )
+    x = jax.device_put(
+        jnp.zeros((_SERVE_SHAPE["max_batch"], 1, cfg.hidden_size),
+                  jnp.float32),
+        NamedSharding(mesh, decode_batch_spec(mesh)),
+    )
+    active = jax.device_put(
+        jnp.ones((_SERVE_SHAPE["max_batch"],), bool),
+        NamedSharding(mesh, P()),
+    )
     if what == "decode":
         fn = build_decode_step(cfg, mesh)
-        x = jax.device_put(
-            jnp.zeros((_SERVE_SHAPE["max_batch"], 1, cfg.hidden_size),
-                      jnp.float32),
-            NamedSharding(mesh, P("dp", None, None)),
-        )
-        active = jax.device_put(
-            jnp.ones((_SERVE_SHAPE["max_batch"],), bool),
+        return fn, ((cache, x), params, active)
+    if what == "decode_fused":
+        fn = build_decode_fused(cfg, mesh, k)
+        remaining = jax.device_put(
+            jnp.full((_SERVE_SHAPE["max_batch"],), k, jnp.int32),
             NamedSharding(mesh, P()),
         )
-        return fn, ((cache, x), params, active)
+        return fn, ((cache, x), params, active, remaining)
+    if what == "prefill_chunk":
+        # second chunk (nonzero static offset): nonempty prefix carry +
+        # offset block write — the interesting lowering
+        from dlbb_tpu.serve.engine import prefix_spec
+
+        chunk = _SERVE_SHAPE["block_size"]
+        fn = build_prefill_chunk(cfg, mesh, chunk, chunk)
+        pre_sh = NamedSharding(mesh, prefix_spec(mesh))
+        pk = jax.device_put(
+            jnp.zeros((cfg.num_layers, chunk, cfg.kv_heads,
+                       cfg.head_dim), jnp.float32), pre_sh)
+        xc = jnp.zeros((1, chunk, cfg.hidden_size), jnp.float32)
+        return fn, (cache, (pk, pk), params, xc, np.int32(0),
+                    np.int32(2 * chunk))
+    if what in ("compact_gather", "compact_scatter"):
+        bucket = _SERVE_SHAPE["max_batch"] // 2
+        idx = jnp.arange(bucket, dtype=jnp.int32)
+        if what == "compact_gather":
+            return build_compact_gather(mesh), ((cache, x), idx)
+        from dlbb_tpu.serve.kvcache import gather_cache_slots
+
+        small_cache = jax.jit(gather_cache_slots)(cache, idx)
+        small_x = x[:bucket]
+        return (build_compact_scatter(mesh),
+                ((cache, x), (small_cache, small_x), idx))
     fn = build_prefill(cfg, mesh)
-    x = jnp.zeros((1, _SERVE_SHAPE["bucket"], cfg.hidden_size),
-                  jnp.float32)
-    return fn, (cache, params, x, np.int32(0),
+    xp = jnp.zeros((1, _SERVE_SHAPE["bucket"], cfg.hidden_size),
+                   jnp.float32)
+    return fn, (cache, params, xp, np.int32(0),
                 np.int32(_SERVE_SHAPE["bucket"]))
 
 
@@ -736,6 +779,78 @@ def _prefill_target(dp: int = 2, tp: int = 4) -> AuditTarget:
             expect_donation=True,
         ),
         min_devices=dp * tp,
+    )
+
+
+def _decode_fused_target(dp: int = 2, tp: int = 4,
+                         k: int = 4) -> AuditTarget:
+    """The fused multi-step decode scan (``serve/engine.py::
+    build_decode_fused``): the scan body may contain only the tiny
+    per-token tp collectives, execution-weighted through the scan's
+    ``known_trip_count`` — the body's row-parallel psum must fire >= k
+    times (the while-body pricing from the schedule auditor), each
+    within ONE step's activation byte ceiling.  A cache regather inside
+    the body is k-times amplified on the wire axis, so the committed
+    schedule baseline turns it into an ``analyze diff`` failure as well
+    as an audit error."""
+    from dlbb_tpu.analysis.expectations import decode_scan_expectation
+
+    def build():
+        return _serve_build(dp, tp, "decode_fused", k=k)
+
+    qkv_width = 3 * _TINY_MODEL["hidden_size"]
+    act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    return AuditTarget(
+        name=f"serve/engine.py::decode_fused[k{k},dp,tp]",
+        build=build,
+        expectation=decode_scan_expectation(dp, tp, k, act_bytes),
+        min_devices=dp * tp,
+    )
+
+
+def _prefill_chunk_target(dp: int = 2, tp: int = 4) -> AuditTarget:
+    """One chunk of a chunked prefill at a nonzero static offset: the
+    prefix K/V rides an explicit (slot-dim-free) carry, so the lowered
+    program must look exactly like monolithic prefill — tp collectives
+    only, one chunk of activations as the ceiling, zero collectives for
+    the cache write, cache carry donated."""
+
+    def build():
+        return _serve_build(dp, tp, "prefill_chunk")
+
+    chunk = _SERVE_SHAPE["block_size"]
+    act_bytes = chunk * 3 * _TINY_MODEL["hidden_size"] * 4
+    return AuditTarget(
+        name="serve/engine.py::prefill_chunk[dp,tp]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=dp, tp=tp, decode=True),
+            required_any={"all-reduce"},
+            min_required=1,
+            max_bytes_per_instr=int(act_bytes * 1.25),
+            expect_donation=True,
+        ),
+        min_devices=dp * tp,
+    )
+
+
+def _compact_target(what: str, tp: int = 4) -> AuditTarget:
+    """Slot compaction (dp=1 by contract): the gather that repacks
+    active slots into the half-size bucket, and the scatter that writes
+    them back, must both lower to ZERO collectives — the slot dim is
+    unsharded and the kv-head shard is untouched, so any collective
+    here means the repack crossed the wire and the variant's pricing is
+    void."""
+    from dlbb_tpu.analysis.expectations import compact_expectation
+
+    def build():
+        return _serve_build(1, tp, what)
+
+    return AuditTarget(
+        name=f"serve/engine.py::{what}[tp]",
+        build=build,
+        expectation=compact_expectation(),
+        min_devices=tp,
     )
 
 
@@ -809,8 +924,10 @@ def default_targets() -> list[AuditTarget]:
     """The repo's standing audit surface: every registry collective, the
     TP/sequence-parallel model forwards (the e2e benchmark's jit) with
     and without the overlapped collective-matmul schedule, the
-    DDP + ZeRO-1 + overlapped-TP train steps, and the serving decode +
-    prefill steps (tiny-collectives-only, cache-regather byte gate)."""
+    DDP + ZeRO-1 + overlapped-TP train steps, and the serving programs
+    — per-step decode + monolithic prefill plus the decode fast path
+    (fused K-step scan, chunked prefill, compaction gather/scatter) —
+    all tiny-collectives-only with the cache-regather byte gate."""
     targets = registry_op_targets()
     targets.append(_barrier_target())
     targets.append(_tp_forward_target())
@@ -824,6 +941,10 @@ def default_targets() -> list[AuditTarget]:
     targets.append(_compressed_train_target("int8"))
     targets.append(_decode_step_target())
     targets.append(_prefill_target())
+    targets.append(_decode_fused_target())
+    targets.append(_prefill_chunk_target())
+    targets.append(_compact_target("compact_gather"))
+    targets.append(_compact_target("compact_scatter"))
     return targets
 
 

@@ -273,6 +273,27 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--queue-capacity", type=int, default=None,
                     dest="queue_capacity",
                     help="admission-control queue bound (default 64)")
+    sv.add_argument("--decode-horizon", type=int, default=None,
+                    dest="decode_horizon",
+                    help="fused-scan horizon cap K: fuse up to K decode "
+                         "steps into one on-device lax.scan dispatch "
+                         "(default 1 = per-step; docs/serving.md)")
+    sv.add_argument("--inflight-window", type=int, default=None,
+                    dest="inflight_window",
+                    help="bounded in-flight decode dispatch window "
+                         "(default 1 = sync every unit; 2 overlaps "
+                         "dispatch N+1 with N's compute)")
+    sv.add_argument("--prefill-chunk", type=int, default=None,
+                    dest="prefill_chunk",
+                    help="chunked prefill: tokens per chunk (a "
+                         "block-size multiple), interleaved with decode "
+                         "steps so long prompts stop head-of-line "
+                         "blocking the batch (default: monolithic)")
+    sv.add_argument("--compact-threshold", type=float, default=None,
+                    dest="compact_threshold",
+                    help="occupancy fraction (0, 0.5] at or below which "
+                         "fused scans run on a gather-compacted half "
+                         "batch (dp=1 meshes only; default: off)")
     sv.add_argument("--output", default=None,
                     help="output directory (default results/serving)")
     sv.add_argument("--simulate", type=int, default=0, metavar="N")
@@ -563,6 +584,19 @@ def _dispatch(args) -> int:
         else:
             print(f"serving: no serving_*.json under {serve_dir} — "
                   "skipped")
+        bench_serve = Path("BENCH_serve.json")
+        if bench_serve.exists():
+            from dlbb_tpu.stats.serving_report import write_fastpath_report
+
+            frows = write_fastpath_report(bench_serve,
+                                          stats_root / "serving")
+            if frows:
+                produced += 1
+                print(f"fastpath: {len(frows)} setting(s) -> "
+                      f"{stats_root / 'serving' / 'FASTPATH.md'}")
+        else:
+            print("fastpath: no BENCH_serve.json at the repo root — "
+                  "skipped")
         from dlbb_tpu.stats.northstar import (
             default_stats_1d_csv,
             write_northstar_report,
@@ -636,6 +670,10 @@ def _dispatch(args) -> int:
                 "block_size": args.block_size,
                 "max_seq": args.max_seq,
                 "queue_capacity": args.queue_capacity,
+                "decode_horizon": args.decode_horizon,
+                "inflight_window": args.inflight_window,
+                "prefill_chunk": args.prefill_chunk,
+                "compact_threshold": args.compact_threshold,
             },
         )
         req = result["requests"]

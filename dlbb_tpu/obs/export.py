@@ -211,9 +211,30 @@ def serving_metrics(report: dict[str, Any],
     registry.set_gauge("serve_wall_seconds",
                        report.get("wall_seconds", 0.0),
                        help="trace wall-clock time")
-    registry.set_gauge("serve_decode_steps",
-                       report.get("decode_steps", 0),
-                       help="continuous-batching decode steps executed")
+    # serve_decode_steps is a live engine COUNTER (each fused-scan trip
+    # counts once); when folding a bare report into a fresh registry,
+    # seed it from the report so the export is self-contained either way
+    if registry.get("serve_decode_steps") == 0:
+        registry.inc("serve_decode_steps", report.get("decode_steps", 0),
+                     help="decode steps executed (each fused-scan trip "
+                          "counts once)")
+    registry.set_gauge("serve_decode_units",
+                       report.get("decode_units",
+                                  report.get("decode_steps", 0)),
+                       help="decode host dispatches (a fused scan is one)")
+    fast = report.get("fast_path", {})
+    for key, hlp in (
+        ("fused_scans", "fused decode scans dispatched"),
+        ("fused_steps", "decode steps executed inside fused scans"),
+        ("prefill_chunks", "prefill chunks processed"),
+        ("compacted_scans", "fused scans run on a compacted batch"),
+    ):
+        if key in fast:
+            registry.set_gauge(f"serve_fastpath_{key}", fast[key])
+    shed = report.get("requests", {}).get("shed_rate")
+    if shed is not None:
+        registry.set_gauge("serve_shed_rate", shed,
+                           help="rejected / arrived requests this run")
     for metric, key in (("serve_ttft_seconds", "ttft"),
                         ("serve_per_token_seconds", "per_token_latency")):
         summary = report.get(key, {})

@@ -319,7 +319,8 @@ def journal_to_trace(journal_dir: "str | Path",
         if name in ("started", "request-arrived") and config:
             open_configs[config] = ts_us
         elif (name in ("completed", "failed", "request-completed",
-                       "request-rejected") and config in open_configs):
+                       "request-rejected", "request-infeasible")
+              and config in open_configs):
             start_us = open_configs.pop(config)
             kind = name[len("request-"):] if name.startswith(
                 "request-") else name

@@ -190,7 +190,9 @@ def run_serve_from_config(
     devices: Optional[Sequence] = None,
     verbose: bool = True,
 ) -> dict[str, Any]:
-    """CLI entry: optional experiment YAML + flag overrides.
+    """CLI entry: optional experiment YAML + flag overrides (including
+    the decode fast-path knobs — decode_horizon / inflight_window /
+    prefill_chunk / compact_threshold, docs/serving.md).
 
     Without ``--config`` the default small GQA model serves on an
     auto-planned (dp, tp) mesh over the available devices."""

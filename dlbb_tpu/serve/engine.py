@@ -34,6 +34,39 @@ may contain only the tiny per-token TP collectives (row-parallel psums
 of ``[max_batch, 1, H]`` + QKV realignment permutes); the cache never
 crosses the wire.  A byte ceiling of activation size proves no step
 accidentally re-gathers the KV-cache.
+
+Decode fast path (``docs/serving.md``, all off by default so the
+engine's legacy per-step behaviour is bit-for-bit preserved):
+
+- **fused multi-step decode** (``decode_horizon > 1``): when the ledger
+  knows no scheduling event is imminent, the next K decode steps run as
+  ONE jitted ``lax.scan`` over the donated ``(cache, x)`` carry — one
+  host dispatch instead of K.  K is chosen per step as
+  ``min(horizon_cap, steps_until_next_event)`` (next event = the
+  earliest completion while anything is waiting for a slot, else the
+  batch's full drain), rounded down to a power-of-two bucket so the
+  scan retraces at most ``log2(horizon)`` times.  Slots that complete
+  mid-scan are masked inactive INSIDE the scan by a per-slot
+  ``remaining`` step budget, so logits stay equivalent to the per-step
+  engine; their block frees happen at scan exit.
+- **host-overlap dispatch** (``inflight_window > 1``): decode units are
+  dispatched without ``block_until_ready`` into a bounded in-flight
+  window (dispatch N+1 while N computes); syncs happen only at scan
+  boundaries — window full, an admission about to prefill, idle, or
+  run end.  TTFT stays honest: the first token is synced exactly as in
+  the per-step engine (prefill blocks on ``y_last``).
+- **chunked prefill** (``prefill_chunk``): long prompts split into
+  fixed-size chunks (block-multiples, one jit per static chunk offset
+  reusing ``_serve_block``) interleaved with decode steps, so a long
+  admission no longer head-of-line-blocks the resident decode batch.
+  Each chunk writes its K/V blocks exactly as monolithic prefill does
+  and carries the running prefix K/V explicitly ([L, start, kvh, d],
+  no slot dim) so the cache is never re-read across the slot shard.
+- **slot compaction** (``compact_threshold``, dp=1 meshes only): when
+  occupancy drops to or below the threshold, active slots are
+  gather-repacked into a half-size decode batch bucket for the fused
+  scan and scattered back at scan exit — priced as a measured variant
+  (``scripts/bench_serving.py``), never assumed to win.
 """
 
 from __future__ import annotations
@@ -106,6 +139,24 @@ class ServingConfig:
     hbm_budget_gb:   per-device HBM budget the build-time footprint gate
                      (``models.configs.validate_serving``) checks the
                      KV-cache against; None disables the gate.
+    decode_horizon:  fused-scan horizon cap K (1 = the legacy per-step
+                     engine; >1 fuses up to K decode steps into one
+                     jitted lax.scan dispatch, bucketed by powers of 2).
+    inflight_window: bounded in-flight decode dispatch window (1 = sync
+                     every unit, the legacy behaviour; >1 dispatches the
+                     next unit while the previous computes and syncs
+                     only at scan boundaries).
+    prefill_chunk:   tokens per prefill chunk (a block multiple; None =
+                     monolithic bucketed prefill).  Long prompts are
+                     processed chunk-by-chunk, interleaved with decode
+                     steps for the resident batch.
+    compact_threshold: occupancy fraction (0, 0.5] at or below which the
+                     fused decode scan runs on a gather-compacted
+                     half-size batch bucket (dp=1 meshes only; None
+                     disables).  A measured variant, not a default win.
+    reject_infeasible: reject-and-journal requests the envelope cannot
+                     serve (reason="infeasible") instead of failing the
+                     whole trace up front (the strict default).
     """
 
     max_batch: int = 8
@@ -115,6 +166,11 @@ class ServingConfig:
     queue_capacity: int = 64
     blocks_budget: Optional[int] = None
     hbm_budget_gb: Optional[float] = 12.0
+    decode_horizon: int = 1
+    inflight_window: int = 1
+    prefill_chunk: Optional[int] = None
+    compact_threshold: Optional[float] = None
+    reject_infeasible: bool = False
 
     def __post_init__(self) -> None:
         if not self.prefill_buckets:
@@ -163,6 +219,67 @@ class ServingConfig:
                 f"serving.blocks_budget must be >= 1, got "
                 f"{self.total_blocks}"
             )
+        if self.decode_horizon < 1:
+            raise ValueError(
+                f"serving.decode_horizon must be >= 1, got "
+                f"{self.decode_horizon}"
+            )
+        if self.inflight_window < 1:
+            raise ValueError(
+                f"serving.inflight_window must be >= 1, got "
+                f"{self.inflight_window}"
+            )
+        if self.inflight_window > 1 and self.decode_horizon < 2:
+            raise ValueError(
+                "serving.inflight_window > 1 requires decode_horizon "
+                ">= 2: per-step (k=1) units never stay in flight (their "
+                "y may alias the donated carry), so the window would be "
+                "a silent no-op on the per-step engine"
+            )
+        if self.prefill_chunk is not None:
+            if (self.prefill_chunk % self.block_size != 0
+                    or not 0 < self.prefill_chunk <= self.max_seq):
+                raise ValueError(
+                    f"serving.prefill_chunk={self.prefill_chunk} must be "
+                    f"a block_size={self.block_size} multiple in "
+                    f"(0, {self.max_seq}]"
+                )
+            if self.max_seq % self.prefill_chunk != 0:
+                # a prompt near max_seq pads to ceil(prompt/chunk)*chunk;
+                # unless the chunk divides max_seq that rounding can
+                # overrun the slot's block ring for a perfectly feasible
+                # request — reject the geometry up front
+                raise ValueError(
+                    f"serving.prefill_chunk={self.prefill_chunk} must "
+                    f"divide serving.max_seq={self.max_seq} (chunk "
+                    "rounding of a near-max_seq prompt would overrun "
+                    "the slot's block ring)"
+                )
+        if self.compact_threshold is not None:
+            if not 0.0 < self.compact_threshold <= 0.5:
+                raise ValueError(
+                    f"serving.compact_threshold must be in (0, 0.5] — "
+                    f"compaction repacks into the half-size batch bucket "
+                    f"(got {self.compact_threshold})"
+                )
+            if self.decode_horizon < 2:
+                raise ValueError(
+                    "serving.compact_threshold requires decode_horizon "
+                    ">= 2: compaction only engages on fused scans, so "
+                    "with the per-step engine it would be a silent no-op "
+                    "that still pays the gather/scatter compiles"
+                )
+            if self.max_batch < 2:
+                raise ValueError(
+                    "serving.compact_threshold needs max_batch >= 2 "
+                    "(nothing to compact into)"
+                )
+            if dp > 1:
+                raise ValueError(
+                    "serving.compact_threshold requires dp=1: the slot "
+                    "gather/scatter must stay shard-local, and the slot "
+                    f"dim is sharded over dp={dp}"
+                )
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.prefill_buckets:
@@ -177,7 +294,9 @@ class ServingConfig:
     def from_dict(cls, d: dict[str, Any]) -> "ServingConfig":
         fields = {}
         for k in ("max_batch", "block_size", "max_seq", "queue_capacity",
-                  "blocks_budget", "hbm_budget_gb"):
+                  "blocks_budget", "hbm_budget_gb", "decode_horizon",
+                  "inflight_window", "prefill_chunk", "compact_threshold",
+                  "reject_infeasible"):
             if k in d:
                 fields[k] = d[k]
         if "prefill_buckets" in d:
@@ -194,7 +313,23 @@ class ServingConfig:
             "queue_capacity": self.queue_capacity,
             "blocks_budget": self.total_blocks,
             "hbm_budget_gb": self.hbm_budget_gb,
+            "decode_horizon": self.decode_horizon,
+            "inflight_window": self.inflight_window,
+            "prefill_chunk": self.prefill_chunk,
+            "compact_threshold": self.compact_threshold,
+            "reject_infeasible": self.reject_infeasible,
         }
+
+    @property
+    def fused_horizons(self) -> tuple[int, ...]:
+        """The power-of-two fused-scan bucket ladder: 2, 4, ... up to
+        ``decode_horizon`` (empty when the fast path is off)."""
+        ks = []
+        k = 2
+        while k <= self.decode_horizon:
+            ks.append(k)
+            k *= 2
+        return tuple(ks)
 
 
 # ---------------------------------------------------------------------------
@@ -209,18 +344,20 @@ def _split_qkv(qkv: jax.Array, config: ModelConfig):
 
 
 def _serve_block(h, layer, config: ModelConfig, attention_step,
-                 k_l, v_l):
+                 cache_state):
     """One transformer block with a pluggable attention step — the ONE
-    copy of the ln1/qkv/out/ln2/ffn structure both serving programs
-    share (the serving twin of ``transformer._block``, whose math the
-    equivalence tests pin it against).  ``attention_step(q, k, v, k_l,
-    v_l) -> (attn [B, S, n*d], k_l, v_l)`` owns everything that differs
-    between prefill (dense causal + block write) and decode (cached
-    append + length-masked read)."""
+    copy of the ln1/qkv/out/ln2/ffn structure every serving program
+    shares (the serving twin of ``transformer._block``, whose math the
+    equivalence tests pin it against).  ``attention_step(q, k, v,
+    cache_state) -> (attn [B, S, n*d], cache_state)`` owns everything
+    that differs between prefill (dense causal + block write), decode
+    (cached append + length-masked read), and chunked prefill (prefix
+    carry + offset block write); ``cache_state`` is an opaque per-layer
+    tuple (the scanned cache leaves, plus the prefix K/V for chunks)."""
     y = _layernorm(h, layer["ln1"]["scale"], layer["ln1"]["bias"])
     qkv = y @ layer["qkv"]["kernel"] + layer["qkv"]["bias"]
     q, k, v = _split_qkv(qkv, config)
-    attn, k_l, v_l = attention_step(q, k, v, k_l, v_l)
+    attn, cache_state = attention_step(q, k, v, cache_state)
     h = attn @ layer["out"]["kernel"] + layer["out"]["bias"] + h
     residual = h
     y2 = _layernorm(h, layer["ln2"]["scale"], layer["ln2"]["bias"])
@@ -228,7 +365,7 @@ def _serve_block(h, layer, config: ModelConfig, attention_step,
     y2 = jax.nn.gelu(y2)
     h = (y2 @ layer["ffn_down"]["kernel"]
          + layer["ffn_down"]["bias"] + residual)
-    return h, (k_l, v_l)
+    return h, cache_state
 
 
 def _heads(t: jax.Array, nh: int, d: int) -> jax.Array:
@@ -268,8 +405,9 @@ def _cached_attention(q: jax.Array, k_flat: jax.Array, v_flat: jax.Array,
 
 
 def _write_prompt_blocks(cache_layer: jax.Array, update: jax.Array,
-                         slot: jax.Array) -> jax.Array:
-    """Masked-select write of a prefill bucket into one slot's blocks.
+                         slot: jax.Array, start_blk: int = 0) -> jax.Array:
+    """Masked-select write of a prefill bucket (or chunk) into one slot's
+    blocks, starting at static block offset ``start_blk``.
 
     cache_layer: ``[B, nb, bs, kvh, d]``; update: ``[wb, bs, kvh, d]``
     (``wb`` = bucket/block_size, static).  One-hot over the slot dim and
@@ -277,9 +415,12 @@ def _write_prompt_blocks(cache_layer: jax.Array, update: jax.Array,
     local to the shard owning the slot (no collective, no regather)."""
     b_dim, nb = cache_layer.shape[:2]
     wb = update.shape[0]
-    padded = jnp.pad(update, ((0, nb - wb), (0, 0), (0, 0), (0, 0)))
+    padded = jnp.pad(update, ((start_blk, nb - start_blk - wb),
+                              (0, 0), (0, 0), (0, 0)))
     slot_mask = (jnp.arange(b_dim) == slot)[:, None, None, None, None]
-    blk_mask = (jnp.arange(nb) < wb)[None, :, None, None, None]
+    blk = jnp.arange(nb)
+    blk_mask = ((blk >= start_blk)
+                & (blk < start_blk + wb))[None, :, None, None, None]
     return jnp.where(slot_mask & blk_mask, padded[None], cache_layer)
 
 
@@ -295,7 +436,8 @@ def build_prefill(config: ModelConfig, mesh: Mesh):
         s_bucket = x.shape[1]
         wb = s_bucket // bs
 
-        def attention_step(q, k, v, k_l, v_l):
+        def attention_step(q, k, v, cache_state):
+            k_l, v_l = cache_state
             qh, kh, vh = (_heads(q, n, d), _heads(k, kvh, d),
                           _heads(v, kvh, d))
             attn = dense_attention(qh, kh, vh, causal=config.causal)
@@ -306,12 +448,12 @@ def build_prefill(config: ModelConfig, mesh: Mesh):
             k_l = _write_prompt_blocks(k_l, k_blocks, slot)
             v_l = _write_prompt_blocks(v_l, v_blocks, slot)
             return (attn.transpose(0, 2, 1, 3).reshape(1, s_bucket, n * d),
-                    k_l, v_l)
+                    (k_l, v_l))
 
         def body(h, layer_and_cache):
             layer, k_l, v_l = layer_and_cache
             return _serve_block(h, layer, config, attention_step,
-                                k_l, v_l)
+                                (k_l, v_l))
 
         h, (k_new, v_new) = jax.lax.scan(
             body, x, (params["layers"], cache.k, cache.v)
@@ -331,11 +473,223 @@ def build_prefill(config: ModelConfig, mesh: Mesh):
     )
 
 
+def prefix_spec(mesh: Mesh) -> P:
+    """Chunked-prefill prefix K/V ``[L, start, kvh, d]``: kv-head dim
+    over tp (the cache's own head split), no slot dim at all — the
+    prefix never touches the dp shard."""
+    axes = getattr(mesh, "axis_names", ())
+    tp = "tp" if "tp" in axes and mesh.shape["tp"] > 1 else None
+    return P(None, None, tp, None)
+
+
+def create_prefix(config: ModelConfig, mesh: Mesh) -> tuple[jax.Array,
+                                                            jax.Array]:
+    """The empty (start=0) prefix carry for a chunked prefill."""
+    from dlbb_tpu.models.transformer import _dtype_of as _dt
+
+    shape = (config.num_layers, 0, config.kv_heads, config.head_dim)
+    zeros = jnp.zeros(shape, _dt(config.dtype))
+    sh = NamedSharding(mesh, prefix_spec(mesh))
+    return (jax.device_put(zeros, sh), jax.device_put(zeros, sh))
+
+
+def _chunk_attention(qh: jax.Array, k_all: jax.Array, v_all: jax.Array,
+                     start: int) -> jax.Array:
+    """Offset-causal fp32 attention for one prefill chunk.
+
+    qh: ``[1, n, C, d]`` (the chunk's queries, global positions
+    ``start..start+C``); k_all/v_all: ``[start+C, kvh, d]`` (prefix +
+    chunk keys).  Same math as ``_cached_attention`` (fp32 softmax,
+    1/sqrt(d), grouped-query broadcasting) with the per-slot validity
+    mask replaced by the STATIC offset-causal mask ``j <= start + qi``
+    — for real query positions this reaches only real keys, so pad
+    positions in a final partial chunk never contaminate a real
+    output (their own rows are discarded by the caller)."""
+    b, n, c, d = qh.shape
+    kvh = k_all.shape[1]
+    s_tot = k_all.shape[0]
+    q32 = qh.astype(jnp.float32)
+    k32 = k_all.transpose(1, 0, 2).astype(jnp.float32)[None]  # [1,kvh,S,d]
+    v32 = v_all.transpose(1, 0, 2).astype(jnp.float32)[None]
+    mask = (jnp.arange(s_tot)[None, :]
+            <= (start + jnp.arange(c))[:, None])            # [C, S]
+    if kvh != n:
+        q32 = q32.reshape(b, kvh, n // kvh, c, d)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", q32, k32) / math.sqrt(d)
+        logits = jnp.where(mask[None, None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v32)
+        out = out.reshape(b, n, c, d)
+    else:
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q32, k32) / math.sqrt(d)
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bnqk,bnkd->bnqd", probs, v32)
+    return out.astype(k_all.dtype)
+
+
+def build_prefill_chunk(config: ModelConfig, mesh: Mesh, chunk_len: int,
+                        start: int):
+    """Jitted ``prefill_chunk(cache, prefix, params, x, slot, length) ->
+    (cache, prefix, y_last)`` — one chunk of a chunked prefill at STATIC
+    global offset ``start`` (a block multiple; one retrace per chunk
+    index, the "bucketed chunk jit").
+
+    The chunk's K/V blocks are written into the slot exactly as
+    monolithic prefill writes its bucket (``_write_prompt_blocks`` at
+    block offset ``start/block_size`` — masked select, shard-local);
+    attention runs over the explicitly-carried prefix K/V (``[L, start,
+    kvh, d]``, no slot dim) concatenated with the chunk, so the
+    dp-sharded cache is never re-read.  ``length`` is the TRUE prompt
+    length; ``y_last`` is the output at the last real position when it
+    falls inside this chunk (the engine uses only the final chunk's).
+    Only the cache is donated (the returned prefix is larger than the
+    input one, so its buffers can never alias)."""
+    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+
+    def prefill_chunk(cache, prefix, params, x, slot, length):
+        bs = cache.block_size
+        wb = chunk_len // bs
+        start_blk = start // bs
+
+        def attention_step(q, k, v, cache_state):
+            k_l, v_l, pk_l, pv_l = cache_state
+            qh = _heads(q, n, d)                        # [1, n, C, d]
+            k_chunk = k[0].reshape(chunk_len, kvh, d)
+            v_chunk = v[0].reshape(chunk_len, kvh, d)
+            k_all = jnp.concatenate([pk_l, k_chunk], axis=0)
+            v_all = jnp.concatenate([pv_l, v_chunk], axis=0)
+            attn = _chunk_attention(qh, k_all, v_all, start)
+            k_l = _write_prompt_blocks(
+                k_l, k_chunk.reshape(wb, bs, kvh, d), slot, start_blk)
+            v_l = _write_prompt_blocks(
+                v_l, v_chunk.reshape(wb, bs, kvh, d), slot, start_blk)
+            return (attn.transpose(0, 2, 1, 3).reshape(1, chunk_len,
+                                                       n * d),
+                    (k_l, v_l, k_all, v_all))
+
+        def body(h, layer_and_cache):
+            layer, k_l, v_l, pk_l, pv_l = layer_and_cache
+            return _serve_block(h, layer, config, attention_step,
+                                (k_l, v_l, pk_l, pv_l))
+
+        pk, pv = prefix
+        h, (k_new, v_new, pk_new, pv_new) = jax.lax.scan(
+            body, x, (params["layers"], cache.k, cache.v, pk, pv)
+        )
+        y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        local = jnp.clip(length - 1 - start, 0, chunk_len - 1)
+        y_last = jax.lax.dynamic_slice(
+            y, (0, local, 0), (1, 1, y.shape[-1])
+        )[0, 0]
+        new_len = jnp.minimum(length, start + chunk_len)
+        lengths = jnp.where(jnp.arange(cache.max_batch) == slot,
+                            new_len, cache.lengths).astype(jnp.int32)
+        return (KVCache(k_new, v_new, lengths), (pk_new, pv_new), y_last)
+
+    pre_sh = NamedSharding(mesh, prefix_spec(mesh))
+    # only the cache is donated: the returned prefix is LARGER than the
+    # input one (start -> start + C), so its buffers can never alias
+    return jax.jit(
+        prefill_chunk,
+        donate_argnums=(0,),
+        out_shardings=(cache_shardings(mesh), (pre_sh, pre_sh),
+                       NamedSharding(mesh, P())),
+    )
+
+
+def build_compact_gather(mesh: Mesh):
+    """Jitted ``gather(carry, idx) -> small_carry``: repack the active
+    slots named by ``idx`` into a smaller decode batch bucket (slot
+    compaction, dp=1 only — the gather must stay shard-local).  The big
+    carry is NOT donated: it survives on device and the compacted scan's
+    results are scattered back into it at scan exit."""
+    from dlbb_tpu.serve.kvcache import gather_cache_slots
+
+    def gather(carry, idx):
+        cache, x = carry
+        return (gather_cache_slots(cache, idx), x[idx])
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    return jax.jit(
+        gather, out_shardings=(cache_shardings(mesh), x_sh),
+    )
+
+
+def build_compact_scatter(mesh: Mesh):
+    """Jitted ``scatter(carry, small_carry, idx) -> carry``: write the
+    compacted rows back into their big-batch slots (only the big carry
+    is donated — the small rows land inside larger output buffers;
+    ``idx`` rows are distinct by construction — active slots padded
+    with distinct free slots, so the scatter is unambiguous)."""
+    from dlbb_tpu.serve.kvcache import scatter_cache_slots
+
+    def scatter(carry, small_carry, idx):
+        cache, x = carry
+        s_cache, s_x = small_carry
+        return (scatter_cache_slots(cache, s_cache, idx),
+                x.at[idx].set(s_x))
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    # only the big carry is donated: the small rows land inside larger
+    # output buffers, so their donation could never be honoured
+    return jax.jit(
+        scatter,
+        donate_argnums=(0,),
+        out_shardings=(cache_shardings(mesh), x_sh),
+    )
+
+
 def decode_batch_spec(mesh: Mesh) -> P:
     """Decode activations ``[max_batch, 1, H]``: slots over dp."""
     axes = getattr(mesh, "axis_names", ())
     dp = "dp" if "dp" in axes and mesh.shape["dp"] > 1 else None
     return P(dp, None, None)
+
+
+def _decode_step_math(carry, params, active, config: ModelConfig):
+    """The decode-step computation shared VERBATIM by the per-step jit
+    and every trip of the fused scan (the equivalence contract between
+    the two engines is that this is the one copy of the math)."""
+    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+    cache, x = carry
+    b_dim, s_max = cache.max_batch, cache.max_seq
+    nb, bs = cache.num_blocks, cache.block_size
+    lengths = cache.lengths
+    pos = jnp.arange(s_max)[None, :]
+    write_mask = (pos == lengths[:, None]) & active[:, None]
+    valid = pos <= lengths[:, None]
+
+    def attention_step(q, k, v, cache_state):
+        k_l, v_l = cache_state
+        qh = _heads(q, n, d)                        # [B, n, 1, d]
+        k_new = k[:, 0].reshape(b_dim, kvh, d)
+        v_new = v[:, 0].reshape(b_dim, kvh, d)
+        # append at each active slot's own length (masked select —
+        # elementwise, shard-local; see serve/kvcache.py)
+        k_flat = k_l.reshape(b_dim, s_max, kvh, d)
+        v_flat = v_l.reshape(b_dim, s_max, kvh, d)
+        k_flat = jnp.where(write_mask[..., None, None],
+                           k_new[:, None], k_flat)
+        v_flat = jnp.where(write_mask[..., None, None],
+                           v_new[:, None], v_flat)
+        attn = _cached_attention(qh, k_flat, v_flat, valid)
+        return (attn.transpose(0, 2, 1, 3).reshape(b_dim, 1, n * d),
+                (k_flat.reshape(b_dim, nb, bs, kvh, d),
+                 v_flat.reshape(b_dim, nb, bs, kvh, d)))
+
+    def body(h, layer_and_cache):
+        layer, k_l, v_l = layer_and_cache
+        return _serve_block(h, layer, config, attention_step,
+                            (k_l, v_l))
+
+    h, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["layers"], cache.k, cache.v)
+    )
+    y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+    lengths = lengths + active.astype(jnp.int32)
+    new_cache = KVCache(k_new, v_new, lengths)
+    return (new_cache, y), y
 
 
 def build_decode_step(config: ModelConfig, mesh: Mesh):
@@ -344,52 +698,69 @@ def build_decode_step(config: ModelConfig, mesh: Mesh):
     The carry is donated; its returned ``x`` is this step's output, so
     the engine (and the calibration harness's carry protocol) feeds
     ``out[0]`` straight back in."""
-    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
 
     def decode_step(carry, params, active):
-        cache, x = carry
-        b_dim, s_max = cache.max_batch, cache.max_seq
-        nb, bs = cache.num_blocks, cache.block_size
-        lengths = cache.lengths
-        pos = jnp.arange(s_max)[None, :]
-        write_mask = (pos == lengths[:, None]) & active[:, None]
-        valid = pos <= lengths[:, None]
-
-        def attention_step(q, k, v, k_l, v_l):
-            qh = _heads(q, n, d)                        # [B, n, 1, d]
-            k_new = k[:, 0].reshape(b_dim, kvh, d)
-            v_new = v[:, 0].reshape(b_dim, kvh, d)
-            # append at each active slot's own length (masked select —
-            # elementwise, shard-local; see serve/kvcache.py)
-            k_flat = k_l.reshape(b_dim, s_max, kvh, d)
-            v_flat = v_l.reshape(b_dim, s_max, kvh, d)
-            k_flat = jnp.where(write_mask[..., None, None],
-                               k_new[:, None], k_flat)
-            v_flat = jnp.where(write_mask[..., None, None],
-                               v_new[:, None], v_flat)
-            attn = _cached_attention(qh, k_flat, v_flat, valid)
-            return (attn.transpose(0, 2, 1, 3).reshape(b_dim, 1, n * d),
-                    k_flat.reshape(b_dim, nb, bs, kvh, d),
-                    v_flat.reshape(b_dim, nb, bs, kvh, d))
-
-        def body(h, layer_and_cache):
-            layer, k_l, v_l = layer_and_cache
-            return _serve_block(h, layer, config, attention_step,
-                                k_l, v_l)
-
-        h, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["layers"], cache.k, cache.v)
-        )
-        y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
-        lengths = lengths + active.astype(jnp.int32)
-        new_cache = KVCache(k_new, v_new, lengths)
-        return (new_cache, y), y
+        return _decode_step_math(carry, params, active, config)
 
     x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
     return jax.jit(
         decode_step,
         donate_argnums=(0,),
         out_shardings=((cache_shardings(mesh), x_sh), x_sh),
+    )
+
+
+def build_decode_fused(config: ModelConfig, mesh: Mesh, k: int):
+    """Jitted ``decode_fused(carry, params, active, remaining) ->
+    (carry, ys)`` — ``k`` decode steps fused into ONE ``lax.scan``
+    dispatch over the donated ``(cache, x)`` carry (static ``k``; the
+    engine keeps a power-of-two ladder of these).
+
+    ``remaining[b]`` is slot ``b``'s step budget within this scan
+    (``min(k, tokens_left)``, 0 for inactive slots): step ``i`` runs
+    with ``active & (i < remaining)``, so a slot that completes
+    mid-scan is masked inactive for the rest of the trips — its cache
+    stops advancing exactly as if the per-step engine had deactivated
+    it, and the ledger frees its blocks at scan exit.  ``ys`` stacks
+    every step's output ``[k, max_batch, 1, H]`` (step t's row is the
+    token each then-active slot generated at trip t)."""
+
+    def decode_fused(carry, params, active, remaining):
+        # the slot-lengths vector deliberately stays OUT of the scan
+        # carry: its trajectory is fully determined by the replicated
+        # (lengths0, active, remaining) inputs — lengths at trip i are
+        # ``lengths0 + active * min(i, remaining)`` — so recomputing it
+        # per trip keeps it replicated everywhere.  Carried through the
+        # loop instead, GSPMD propagates the cache's dp sharding onto
+        # it and re-gathers at the loop boundary — a (tiny, but
+        # contract-breaking) collective the decode kind-set forbids.
+        # The trip index rides the carry as a scalar for the same
+        # reason (an arange-xs array invites an iota reshard).
+        cache0, x0 = carry
+        lengths0 = cache0.lengths
+        act_i32 = active.astype(jnp.int32)
+
+        def step(c, _):
+            k_c, v_c, x, i = c
+            step_active = active & (i < remaining)
+            lengths_i = lengths0 + act_i32 * jnp.minimum(i, remaining)
+            (cache, x2), y = _decode_step_math(
+                (KVCache(k_c, v_c, lengths_i), x), params, step_active,
+                config)
+            return (cache.k, cache.v, x2, i + 1), y
+
+        (k_c, v_c, x, _i), ys = jax.lax.scan(
+            step, (cache0.k, cache0.v, x0, jnp.int32(0)), None, length=k)
+        lengths_f = lengths0 + act_i32 * jnp.minimum(jnp.int32(k),
+                                                     remaining)
+        return (KVCache(k_c, v_c, lengths_f), x), ys
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    ys_sh = NamedSharding(mesh, P(None, *decode_batch_spec(mesh)))
+    return jax.jit(
+        decode_fused,
+        donate_argnums=(0,),
+        out_shardings=((cache_shardings(mesh), x_sh), ys_sh),
     )
 
 
@@ -423,7 +794,13 @@ class _RunStats:
     e2e_latency_s: list[float] = field(default_factory=list)
     completed_output_tokens: int = 0
     generated_tokens: int = 0
-    decode_steps: int = 0
+    decode_steps: int = 0       # decode steps executed (fused trips count)
+    decode_units: int = 0       # host dispatches (a fused scan is ONE)
+    fused_scans: int = 0
+    fused_steps: int = 0
+    single_steps: int = 0
+    prefill_chunks: int = 0
+    compacted_scans: int = 0
 
 
 class ServingEngine:
@@ -443,6 +820,7 @@ class ServingEngine:
         registry: Optional[MetricsRegistry] = None,
         seed: int = 0,
         verbose: bool = True,
+        capture_tokens: bool = False,
     ) -> None:
         axes = mesh.axis_names
         self.dp = mesh.shape["dp"] if "dp" in axes else 1
@@ -452,6 +830,10 @@ class ServingEngine:
         self.serving = serving
         self.mesh = mesh
         self.verbose = verbose
+        # the equivalence gate: argmax "token ids" of every generated
+        # output recorded per request (syncs each unit — leave off for
+        # perf runs)
+        self.capture_tokens = capture_tokens
         # public and reassignable: the bench wires one journal per run
         # directory; tests swap it between run_trace calls
         self.journal = journal
@@ -461,12 +843,39 @@ class ServingEngine:
             initial=("arrived", "admitted", "rejected", "completed"),
             help="request lifecycle outcomes",
         )
+        self._rejections = self.registry.labeled_counter(
+            "serve_rejections", "reason",
+            initial=("queue-full", "infeasible"),
+            help="requests shed, by rejection reason",
+        )
+        for name, hlp in (
+            ("serve_decode_steps",
+             "decode steps executed (each fused-scan trip counts once)"),
+            ("serve_fused_scan_steps",
+             "decode steps executed inside fused lax.scan dispatches"),
+            ("serve_prefill_chunks", "prefill chunks processed"),
+        ):
+            self.registry.inc(name, 0, help=hlp)
         self._dtype = _dtype_of(config.dtype)
         self.params = (params if params is not None
                        else init_params_sharded(config, jax.random.key(seed),
                                                 mesh))
         self._prefill = build_prefill(config, mesh)
         self._decode = build_decode_step(config, mesh)
+        self._fused_ks = serving.fused_horizons
+        self._decode_fused = {
+            k: build_decode_fused(config, mesh, k) for k in self._fused_ks
+        }
+        self._prefill_chunk_jits: dict[int, Any] = {}
+        self._compact_gather_fn = None
+        self._compact_scatter_fn = None
+        if serving.compact_threshold is not None:
+            self._compact_gather_fn = build_compact_gather(mesh)
+            self._compact_scatter_fn = build_compact_scatter(mesh)
+        self._fast = (serving.decode_horizon > 1
+                      or serving.inflight_window > 1
+                      or serving.prefill_chunk is not None
+                      or serving.compact_threshold is not None)
         self._inject = jax.jit(_inject_token, donate_argnums=(0,))
         self._x_sharding = NamedSharding(mesh, decode_batch_spec(mesh))
         self._active_sharding = NamedSharding(mesh, P())
@@ -491,53 +900,98 @@ class ServingEngine:
         )
         return (cache, x)
 
+    def _infeasible_reason(self, r: Request) -> Optional[str]:
+        """Why the envelope can never serve ``r`` (None = feasible)."""
+        max_bucket = self.serving.prefill_buckets[-1]
+        if r.output_len < 1:
+            return f"output_len must be >= 1 (got {r.output_len})"
+        if r.prompt_len < 1 or r.prompt_len > max_bucket:
+            return (f"prompt_len={r.prompt_len} outside (0, {max_bucket}] "
+                    "(largest prefill bucket)")
+        if r.total_tokens > self.serving.max_seq:
+            return (f"prompt+output={r.total_tokens} exceeds "
+                    f"serving.max_seq={self.serving.max_seq} "
+                    "(per-slot cache capacity)")
+        need = max(1, math.ceil(r.total_tokens / self.serving.block_size))
+        if need > self.serving.total_blocks:
+            return (f"needs {need} cache blocks, budget is "
+                    f"{self.serving.total_blocks} (serving.blocks_budget)")
+        return None
+
     def _validate_trace(self, trace: TrafficTrace) -> None:
         """Fail BEFORE the run on any request the config cannot serve —
-        an infeasible request rejected mid-trace would read as load."""
-        max_bucket = self.serving.prefill_buckets[-1]
-        ledger_cap = self.serving.total_blocks
+        an infeasible request rejected mid-trace would read as load.
+        (``serving.reject_infeasible`` flips this into per-request
+        runtime rejection, journaled with reason="infeasible".)"""
         for r in trace:
-            if r.output_len < 1:
-                raise ValueError(
-                    f"request {r.rid}: output_len must be >= 1 "
-                    f"(got {r.output_len})"
-                )
-            if r.prompt_len < 1 or r.prompt_len > max_bucket:
-                raise ValueError(
-                    f"request {r.rid}: prompt_len={r.prompt_len} outside "
-                    f"(0, {max_bucket}] (largest prefill bucket)"
-                )
-            if r.total_tokens > self.serving.max_seq:
-                raise ValueError(
-                    f"request {r.rid}: prompt+output={r.total_tokens} "
-                    f"exceeds serving.max_seq={self.serving.max_seq} "
-                    "(per-slot cache capacity)"
-                )
-            need = max(1, math.ceil(r.total_tokens
-                                    / self.serving.block_size))
-            if need > ledger_cap:
-                raise ValueError(
-                    f"request {r.rid}: needs {need} cache blocks, budget "
-                    f"is {ledger_cap} (serving.blocks_budget)"
-                )
+            reason = self._infeasible_reason(r)
+            if reason is not None:
+                raise ValueError(f"request {r.rid}: {reason}")
 
-    def _compile(self, buckets: list[int]) -> None:
-        """Warm every jit the trace will hit (prefill per bucket, decode,
+    def _chunk_jit(self, chunk_index: int):
+        """The chunked-prefill jit for static chunk offset
+        ``chunk_index * prefill_chunk`` (one retrace per offset — the
+        bucketed chunk ladder; built lazily, warmed by ``_compile``)."""
+        jit = self._prefill_chunk_jits.get(chunk_index)
+        if jit is None:
+            chunk = self.serving.prefill_chunk
+            jit = build_prefill_chunk(self.config, self.mesh, chunk,
+                                      chunk_index * chunk)
+            self._prefill_chunk_jits[chunk_index] = jit
+        return jit
+
+    def _compile(self, buckets: list[int], max_chunks: int = 0) -> None:
+        """Warm every jit the trace will hit (prefill per bucket or per
+        chunk offset, decode + the fused-scan ladder, compaction,
         inject) on scratch state, so compile time never lands in TTFT."""
         carry = self._fresh_carry()
+        cfg = self.serving
         active = jax.device_put(
-            jnp.zeros((self.serving.max_batch,), bool),
-            self._active_sharding,
+            jnp.zeros((cfg.max_batch,), bool), self._active_sharding,
         )
+        y_last = None
         for b in buckets:
             dummy = request_embeddings(0, b, self.config.hidden_size,
                                        dtype=self._dtype, pad_to=b)
             cache, y_last = self._prefill(
                 carry[0], self.params, dummy, np.int32(0), np.int32(b))
             carry = (cache, carry[1])
+        if max_chunks:
+            chunk = cfg.prefill_chunk
+            total = max_chunks * chunk
+            dummy = request_embeddings(0, total, self.config.hidden_size,
+                                       dtype=self._dtype, pad_to=total)
+            prefix = create_prefix(self.config, self.mesh)
+            cache = carry[0]
+            for ci in range(max_chunks):
+                cache, prefix, y_last = self._chunk_jit(ci)(
+                    cache, prefix, self.params,
+                    dummy[:, ci * chunk:(ci + 1) * chunk],
+                    np.int32(0), np.int32(total))
+            carry = (cache, carry[1])
         carry = self._inject(carry, np.int32(0), y_last)
-        carry, y = self._decode(carry, self.params, active)
-        jax.block_until_ready(y)
+        carry, _y = self._decode(carry, self.params, active)
+        remaining = jax.device_put(
+            jnp.zeros((cfg.max_batch,), jnp.int32), self._active_sharding)
+        for k in self._fused_ks:
+            carry, _ys = self._decode_fused[k](carry, self.params, active,
+                                               remaining)
+        if self._compact_gather_fn is not None:
+            bucket = cfg.max_batch // 2
+            idx = jax.device_put(jnp.arange(bucket, dtype=jnp.int32),
+                                 self._active_sharding)
+            s_active = jax.device_put(jnp.zeros((bucket,), bool),
+                                      self._active_sharding)
+            s_rem = jax.device_put(jnp.zeros((bucket,), jnp.int32),
+                                   self._active_sharding)
+            small = self._compact_gather_fn(carry, idx)
+            for k in self._fused_ks:
+                small, _ys = self._decode_fused[k](small, self.params,
+                                                   s_active, s_rem)
+            carry = self._compact_scatter_fn(carry, small, idx)
+        # block on the live carry, not an intermediate output: earlier
+        # outputs may share buffers with a carry a later warm call donated
+        jax.block_until_ready(carry[1])
 
     def _event(self, event: str, rid: int, **extra: Any) -> None:
         if self.journal is not None:
@@ -551,17 +1005,35 @@ class ServingEngine:
         scheduling — writing artifacts is ``serve/bench.py``'s job."""
         if not len(trace):
             raise ValueError("cannot serve an empty trace")
-        self._validate_trace(trace)
         cfg = self.serving
-        buckets = sorted({cfg.bucket_for(r.prompt_len) for r in trace})
+        if cfg.reject_infeasible:
+            feasible = [r for r in trace
+                        if self._infeasible_reason(r) is None]
+            if not feasible:
+                raise ValueError(
+                    "every request in the trace is infeasible for this "
+                    "serving envelope — nothing to serve"
+                )
+        else:
+            self._validate_trace(trace)
+            feasible = list(trace)
+        if cfg.prefill_chunk is not None:
+            buckets: list[int] = []
+            max_chunks = max(-(-r.prompt_len // cfg.prefill_chunk)
+                             for r in feasible)
+        else:
+            buckets = sorted({cfg.bucket_for(r.prompt_len)
+                              for r in feasible})
+            max_chunks = 0
         with Timer() as t_compile:
-            self._compile(buckets)
+            self._compile(buckets, max_chunks)
         compile_time = t_compile.elapsed
 
         ledger = BlockLedger(cfg.total_blocks, cfg.block_size)
         # registry counters are cumulative across an engine's lifetime
         # (Prometheus semantics); the report carries THIS run's deltas
         counts_base = {k: self._requests[k] for k in self._requests}
+        shed_base = self._rejections["queue-full"]
         pending = deque(sorted(trace, key=lambda r: (r.arrival_s, r.rid)))
         queue: deque[Request] = deque()
         slots: dict[int, _SlotState] = {}
@@ -575,20 +1047,42 @@ class ServingEngine:
         active_np = np.zeros((cfg.max_batch,), bool)
         active_dev = jax.device_put(jnp.asarray(active_np),
                                     self._active_sharding)
-        rejected_detail: list[int] = []
+        rejected_detail: list[dict[str, Any]] = []
+        tokens_by_rid: dict[int, list[int]] = {}
+        # bounded in-flight window: decode units dispatched but not yet
+        # synced (cfg.inflight_window == 1 syncs every unit — the
+        # legacy cadence); last_sync anchors the per-unit interval so
+        # back-to-back units never double-count queued device time
+        inflight: deque[dict[str, Any]] = deque()
+        last_sync = [0.0]
+        # host-side active_np mutations are staged; the device mask is
+        # re-uploaded lazily, and ALWAYS before a decode dispatch — a
+        # decode interleaved into the admission loop (chunked prefill)
+        # must see slots admitted earlier in the same loop
+        active_dirty = [False]
 
         def refresh_active() -> None:
             nonlocal active_dev
-            active_dev = jax.device_put(jnp.asarray(active_np),
-                                        self._active_sharding)
+            if active_dirty[0]:
+                active_dev = jax.device_put(jnp.asarray(active_np),
+                                            self._active_sharding)
+                active_dirty[0] = False
 
-        def complete(slot: int) -> None:
+        def release(slot: int) -> _SlotState:
+            """Host scan-exit: free a completed slot's blocks + slot so
+            the next admission can reuse them (device order is safe —
+            the scan already masked the slot inactive)."""
             st = slots.pop(slot)
             ledger.free(slot)
             active_np[slot] = False
+            active_dirty[0] = True
             free_slots.append(slot)
             free_slots.sort()
-            done_at = self._now()
+            return st
+
+        def finish(st: _SlotState, done_at: float) -> None:
+            """Completion stats + journal at the unit's SYNC point (the
+            honest timestamp — the device work is provably done)."""
             stats.e2e_latency_s.append(done_at - st.req.arrival_s)
             stats.completed_output_tokens += st.req.output_len
             self._requests["completed"] += 1
@@ -596,7 +1090,174 @@ class ServingEngine:
                         output_tokens=st.req.output_len,
                         latency_s=round(done_at - st.req.arrival_s, 6))
 
+        # EMA of the observed per-step interval: the horizon policy uses
+        # it to convert "next arrival in X seconds" into a step budget
+        step_ema = [0.0]
+
+        def sync_one() -> None:
+            unit = inflight.popleft()
+            jax.block_until_ready(unit["ys"])
+            t_ready = time.perf_counter()
+            dt = t_ready - max(unit["t0"], last_sync[0])
+            last_sync[0] = t_ready
+            stats.decode_step_s.append(dt)
+            per_step = dt / unit["k_exec"]
+            step_ema[0] = (per_step if step_ema[0] == 0.0
+                           else 0.5 * step_ema[0] + 0.5 * per_step)
+            for _row, _slot, _rid, steps in unit["rows"]:
+                for _ in range(steps):
+                    stats.per_token_s.append(dt / unit["k_exec"])
+            done_at = self._now()
+            for st in unit["completions"]:
+                finish(st, done_at)
+            if self.capture_tokens:
+                ys_np = np.asarray(unit["ys"], np.float32)
+                if ys_np.ndim == 3:        # per-step unit: [B, 1, H]
+                    ys_np = ys_np[None]
+                for row, _slot, rid, steps in unit["rows"]:
+                    for i in range(steps):
+                        tokens_by_rid.setdefault(rid, []).append(
+                            int(np.argmax(ys_np[i, row, 0])))
+
+        def drain() -> None:
+            while inflight:
+                sync_one()
+
+        def dispatch_decode(max_k: Optional[int] = None) -> None:
+            """One decode unit over the resident batch: a single step,
+            or — when no scheduling event needs an earlier boundary — a
+            fused K-step scan (largest power-of-two bucket <= the
+            event horizon), optionally on a compacted half batch.
+            ``max_k`` caps the horizon (the chunked-prefill interleave
+            passes 1: the mid-admission request is itself a waiter, and
+            a full fused scan between chunks would re-create the
+            head-of-line blocking the interleave exists to remove)."""
+            nonlocal carry
+            refresh_active()
+            rem = {s: slots[s].req.output_len - slots[s].tokens_done
+                   for s in sorted(slots)}
+            # next event: the earliest completion while anything is (or
+            # may soon be) waiting for a slot; a quiescent batch fuses
+            # through its full drain
+            horizon = (min(rem.values()) if (queue or pending)
+                       else max(rem.values()))
+            horizon = min(cfg.decode_horizon, horizon)
+            if pending:
+                # a known arrival is a scheduling event too: bound the
+                # scan so admission happens near the arrival instead of
+                # up to decode_horizon steps late (steps estimated from
+                # the observed per-step interval; before the first
+                # sample exists, stay per-step — one unit bootstraps
+                # the EMA)
+                if step_ema[0] > 0.0:
+                    gap = pending[0].arrival_s - self._now()
+                    steps_to_arrival = (max(1, int(gap / step_ema[0]))
+                                        if gap > 0 else 1)
+                    horizon = min(horizon, steps_to_arrival)
+                else:
+                    horizon = 1
+            if max_k is not None:
+                horizon = min(horizon, max_k)
+            k = 1
+            for cand in self._fused_ks:
+                if cand <= horizon:
+                    k = cand
+            steps = {s: min(k, r) for s, r in rem.items()}
+            compact = (
+                self._compact_gather_fn is not None and k > 1
+                and len(slots) <= cfg.compact_threshold * cfg.max_batch
+                and len(slots) <= cfg.max_batch // 2
+            )
+            rows: list[tuple[int, int, int, int]] = []
+            t0 = time.perf_counter()
+            # ONE span per dispatched unit, covering dispatch AND the
+            # boundary sync below — in the per-step/window=1 cadence
+            # the span therefore spans the real step wall (as PR-9's
+            # did); under a deeper window the synced device time
+            # belongs to an older unit and per-unit device attribution
+            # lives in decode_step_s/per_token_s instead
+            span_args = dict(active=len(slots), steps=k)
+            if compact:
+                span_args["compacted"] = True
+            with spans.span("serve-decode", **span_args):
+                if k == 1:
+                    carry, ys = self._decode(carry, self.params,
+                                             active_dev)
+                    stats.single_steps += 1
+                    for s in sorted(steps):
+                        rows.append((s, s, slots[s].req.rid, 1))
+                elif compact:
+                    bucket = cfg.max_batch // 2
+                    act = sorted(slots)
+                    idx_np = np.asarray(
+                        act + free_slots[:bucket - len(act)], np.int32)
+                    idx = jax.device_put(jnp.asarray(idx_np),
+                                         self._active_sharding)
+                    s_act_np = np.zeros((bucket,), bool)
+                    s_act_np[:len(act)] = True
+                    s_rem_np = np.zeros((bucket,), np.int32)
+                    for i, s in enumerate(act):
+                        s_rem_np[i] = steps[s]
+                    s_act = jax.device_put(jnp.asarray(s_act_np),
+                                           self._active_sharding)
+                    s_rem = jax.device_put(jnp.asarray(s_rem_np),
+                                           self._active_sharding)
+                    small = self._compact_gather_fn(carry, idx)
+                    small, ys = self._decode_fused[k](
+                        small, self.params, s_act, s_rem)
+                    carry = self._compact_scatter_fn(carry, small, idx)
+                    stats.fused_scans += 1
+                    stats.fused_steps += k
+                    stats.compacted_scans += 1
+                    self.registry.inc("serve_fused_scan_steps", k)
+                    for i, s in enumerate(act):
+                        rows.append((i, s, slots[s].req.rid, steps[s]))
+                else:
+                    rem_np = np.zeros((cfg.max_batch,), np.int32)
+                    for s, m in steps.items():
+                        rem_np[s] = m
+                    rem_dev = jax.device_put(jnp.asarray(rem_np),
+                                             self._active_sharding)
+                    carry, ys = self._decode_fused[k](
+                        carry, self.params, active_dev, rem_dev)
+                    stats.fused_scans += 1
+                    stats.fused_steps += k
+                    self.registry.inc("serve_fused_scan_steps", k)
+                    for s in sorted(steps):
+                        rows.append((s, s, slots[s].req.rid, steps[s]))
+                # host bookkeeping at scan exit: the ledger's known
+                # lengths make every step's outcome deterministic at
+                # dispatch time
+                completions = []
+                for s, m in sorted(steps.items()):
+                    st = slots[s]
+                    st.tokens_done += m
+                    ledger.append(s, m)
+                    stats.generated_tokens += m
+                    if st.tokens_done >= st.req.output_len:
+                        completions.append(s)
+                stats.decode_steps += k
+                stats.decode_units += 1
+                self.registry.inc("serve_decode_steps", k)
+                done_states = [release(s) for s in completions]
+                if completions:
+                    refresh_active()
+                inflight.append({"t0": t0, "ys": ys, "k_exec": k,
+                                 "rows": rows,
+                                 "completions": done_states})
+                # a k==1 unit's y is the SAME logical value as the
+                # carry's x (decode_step returns ((cache, y), y)); on
+                # donation-honoring backends the duplicate outputs may
+                # alias one buffer, and the next dispatch donating the
+                # carry would invalidate the held ys — so per-step
+                # units never stay in flight (a fused scan's stacked
+                # ys is its own buffer and may)
+                window = 1 if k == 1 else cfg.inflight_window
+                while len(inflight) >= window:
+                    sync_one()
+
         self._t0 = time.perf_counter()
+        last_sync[0] = self._t0
         while pending or queue or slots:
             now = self._now()
             # 1. arrivals -> admission control (bounded queue)
@@ -605,12 +1266,34 @@ class ServingEngine:
                 self._requests["arrived"] += 1
                 self._event("request-arrived", req.rid,
                             prompt=req.prompt_len, output=req.output_len)
-                if len(queue) >= cfg.queue_capacity:
+                reason = (self._infeasible_reason(req)
+                          if cfg.reject_infeasible else None)
+                if reason is not None:
                     self._requests["rejected"] += 1
-                    rejected_detail.append(req.rid)
+                    self._rejections["infeasible"] += 1
+                    rejected_detail.append({
+                        "rid": req.rid, "reason": "infeasible",
+                        "queue_depth": len(queue), "queue_wait_s": 0.0,
+                        "detail": reason,
+                    })
+                    # distinct journal event from the load-shed path:
+                    # infeasible is a config/trace mismatch, never load
+                    self._event("request-infeasible", req.rid,
+                                reason="infeasible", detail=reason)
+                elif len(queue) >= cfg.queue_capacity:
+                    head_wait = (now - queue[0].arrival_s if queue
+                                 else 0.0)
+                    self._requests["rejected"] += 1
+                    self._rejections["queue-full"] += 1
+                    rejected_detail.append({
+                        "rid": req.rid, "reason": "queue-full",
+                        "queue_depth": len(queue),
+                        "queue_wait_s": round(head_wait, 6),
+                    })
                     self._event("request-rejected", req.rid,
                                 reason="queue-full",
-                                queue_depth=len(queue))
+                                queue_depth=len(queue),
+                                queue_wait_s=round(head_wait, 6))
                 else:
                     queue.append(req)
                     self._requests["admitted"] += 1
@@ -620,6 +1303,10 @@ class ServingEngine:
             #    reservations, prefill each granted request
             scheduled = False
             if queue and free_slots:
+                # scan boundary: settle in-flight decode before the
+                # prefill blocks, so its sync cost lands in decode
+                # timing and TTFT stays honest
+                drain()
                 with spans.span("serve-admission", queue=len(queue),
                                 free_slots=len(free_slots)):
                     while (queue and free_slots
@@ -627,22 +1314,75 @@ class ServingEngine:
                         req = queue.popleft()
                         slot = free_slots.pop(0)
                         ledger.reserve(slot, req.total_tokens)
-                        bucket = cfg.bucket_for(req.prompt_len)
-                        x_prompt = request_embeddings(
-                            req.seed, req.prompt_len,
-                            self.config.hidden_size, dtype=self._dtype,
-                            pad_to=bucket,
-                        )
-                        with spans.span("serve-prefill", rid=req.rid,
-                                        bucket=bucket, slot=slot):
-                            t0 = time.perf_counter()
-                            cache, y_last = self._prefill(
-                                carry[0], self.params, x_prompt,
-                                np.int32(slot), np.int32(req.prompt_len))
-                            jax.block_until_ready(y_last)
-                            dt = time.perf_counter() - t0
-                        carry = self._inject((cache, carry[1]),
-                                             np.int32(slot), y_last)
+                        if cfg.prefill_chunk is not None:
+                            chunk = cfg.prefill_chunk
+                            n_chunks = -(-req.prompt_len // chunk)
+                            bucket = n_chunks * chunk
+                            x_prompt = request_embeddings(
+                                req.seed, req.prompt_len,
+                                self.config.hidden_size,
+                                dtype=self._dtype, pad_to=bucket,
+                            )
+                            with spans.span("serve-prefill", rid=req.rid,
+                                            bucket=bucket, slot=slot,
+                                            chunks=n_chunks):
+                                t0 = time.perf_counter()
+                                decode_spent = 0.0
+                                prefix = create_prefix(self.config,
+                                                       self.mesh)
+                                cache = carry[0]
+                                for ci in range(n_chunks):
+                                    with spans.span(
+                                            "serve-prefill-chunk",
+                                            rid=req.rid, chunk=ci):
+                                        cache, prefix, y_last = \
+                                            self._chunk_jit(ci)(
+                                                cache, prefix,
+                                                self.params,
+                                                x_prompt[:, ci * chunk:
+                                                         (ci + 1) * chunk],
+                                                np.int32(slot),
+                                                np.int32(req.prompt_len))
+                                    stats.prefill_chunks += 1
+                                    self.registry.inc(
+                                        "serve_prefill_chunks")
+                                    if ci < n_chunks - 1 and slots:
+                                        # interleave: the resident batch
+                                        # decodes between chunks instead
+                                        # of head-of-line blocking
+                                        carry = (cache, carry[1])
+                                        td = time.perf_counter()
+                                        dispatch_decode(max_k=1)
+                                        decode_spent += (
+                                            time.perf_counter() - td)
+                                        cache = carry[0]
+                                carry = (cache, carry[1])
+                                jax.block_until_ready(y_last)
+                                # the interleaved units' dispatch+sync
+                                # time is already billed to
+                                # decode_step_s/per_token_s — keep
+                                # prefill_s a PREFILL cost
+                                dt = (time.perf_counter() - t0
+                                      - decode_spent)
+                        else:
+                            bucket = cfg.bucket_for(req.prompt_len)
+                            x_prompt = request_embeddings(
+                                req.seed, req.prompt_len,
+                                self.config.hidden_size,
+                                dtype=self._dtype, pad_to=bucket,
+                            )
+                            with spans.span("serve-prefill", rid=req.rid,
+                                            bucket=bucket, slot=slot):
+                                t0 = time.perf_counter()
+                                cache, y_last = self._prefill(
+                                    carry[0], self.params, x_prompt,
+                                    np.int32(slot),
+                                    np.int32(req.prompt_len))
+                                jax.block_until_ready(y_last)
+                                dt = time.perf_counter() - t0
+                            carry = (cache, carry[1])
+                        carry = self._inject(carry, np.int32(slot),
+                                             y_last)
                         ledger.append(slot, req.prompt_len)
                         t_first = self._now()
                         st = _SlotState(req=req, tokens_done=1,
@@ -650,43 +1390,30 @@ class ServingEngine:
                                         first_token_s=t_first)
                         slots[slot] = st
                         active_np[slot] = True
+                        active_dirty[0] = True
                         stats.ttft_s.append(t_first - req.arrival_s)
                         stats.prefill_s.append(dt)
                         stats.generated_tokens += 1
                         scheduled = True
+                        if self.capture_tokens:
+                            tokens_by_rid.setdefault(req.rid, []).append(
+                                int(np.argmax(
+                                    np.asarray(y_last, np.float32))))
                         self._event("request-prefill", req.rid, slot=slot,
                                     bucket=bucket,
                                     ttft_s=round(t_first - req.arrival_s, 6))
                         if st.tokens_done >= req.output_len:
-                            complete(slot)
+                            finish(release(slot), self._now())
                 if scheduled:
                     refresh_active()
-            # 3. one continuous-batching decode step over every resident
-            #    request
+            # 3. a decode unit over every resident request: one step, or
+            #    a fused K-step scan on the fast path
             if slots:
-                with spans.span("serve-decode", active=len(slots)):
-                    t0 = time.perf_counter()
-                    carry, y = self._decode(carry, self.params, active_dev)
-                    jax.block_until_ready(y)
-                    dt = time.perf_counter() - t0
-                stats.decode_step_s.append(dt)
-                stats.decode_steps += 1
-                finished = []
-                for slot in sorted(slots):
-                    st = slots[slot]
-                    st.tokens_done += 1
-                    ledger.append(slot, 1)
-                    stats.per_token_s.append(dt)
-                    stats.generated_tokens += 1
-                    if st.tokens_done >= st.req.output_len:
-                        finished.append(slot)
-                for slot in finished:
-                    complete(slot)
-                if finished:
-                    refresh_active()
+                dispatch_decode()
             elif pending and not queue:
                 # idle until the next arrival (nothing resident, nothing
-                # admittable)
+                # admittable); settle any in-flight tail first
+                drain()
                 wait = pending[0].arrival_s - self._now()
                 if wait > 0:
                     time.sleep(min(wait, 0.05))
@@ -700,9 +1427,14 @@ class ServingEngine:
                                     help="bounded admission queue depth")
             self.registry.set_gauge("serve_active_slots", len(slots),
                                     help="decode slots in use")
+            self.registry.set_gauge(
+                "serve_decode_batch_occupancy",
+                len(slots) / cfg.max_batch,
+                help="resident fraction of the decode batch")
             self.registry.set_gauge("serve_cache_blocks_in_use",
                                     ledger.blocks_in_use,
                                     help="cache blocks holding tokens")
+        drain()
         wall = self._now()
 
         self.registry.set_gauge("serve_queue_depth_peak",
@@ -710,6 +1442,12 @@ class ServingEngine:
         self.registry.set_gauge("serve_cache_blocks_peak",
                                 ledger.peak_in_use)
         goodput = (stats.completed_output_tokens / wall) if wall > 0 else 0.0
+        arrived = self._requests["arrived"] - counts_base["arrived"]
+        # shed rate counts LOAD shedding only (queue-full) — an
+        # infeasible rejection is a config/trace mismatch, and folding
+        # it in would misread as pressure and prompt a pointless
+        # queue_capacity tune
+        shed = self._rejections["queue-full"] - shed_base
         report = {
             "schema": SERVING_REPORT_SCHEMA,
             "model": {
@@ -733,7 +1471,9 @@ class ServingEngine:
                 **{k: self._requests[k] - counts_base[k]
                    for k in ("arrived", "admitted", "rejected",
                              "completed")},
-                "rejected_rids": rejected_detail,
+                "rejected_rids": [d["rid"] for d in rejected_detail],
+                "rejected_detail": rejected_detail,
+                "shed_rate": (shed / arrived) if arrived else 0.0,
             },
             "goodput_tokens_per_s": goodput,
             "throughput_tokens_per_s": (
@@ -742,6 +1482,19 @@ class ServingEngine:
             "completed_output_tokens": stats.completed_output_tokens,
             "generated_tokens": stats.generated_tokens,
             "decode_steps": stats.decode_steps,
+            "decode_units": stats.decode_units,
+            "fast_path": {
+                "enabled": self._fast,
+                "decode_horizon": cfg.decode_horizon,
+                "inflight_window": cfg.inflight_window,
+                "prefill_chunk": cfg.prefill_chunk,
+                "compact_threshold": cfg.compact_threshold,
+                "fused_scans": stats.fused_scans,
+                "fused_steps": stats.fused_steps,
+                "single_steps": stats.single_steps,
+                "prefill_chunks": stats.prefill_chunks,
+                "compacted_scans": stats.compacted_scans,
+            },
             "ttft": summarize(stats.ttft_s),
             "per_token_latency": summarize(stats.per_token_s),
             "e2e_latency": summarize(stats.e2e_latency_s),
@@ -752,6 +1505,10 @@ class ServingEngine:
             "compile_time_s": compile_time,
             "wall_seconds": wall,
         }
+        if self.capture_tokens:
+            report["completed_tokens"] = {
+                str(rid): toks for rid, toks in sorted(tokens_by_rid.items())
+            }
         if self.verbose:
             ttft = report["ttft"]
             ptl = report["per_token_latency"]

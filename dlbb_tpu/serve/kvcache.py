@@ -113,6 +113,33 @@ def create_kv_cache(
     return jax.jit(build, out_shardings=cache_shardings(mesh))()
 
 
+def gather_cache_slots(cache: KVCache, idx: jax.Array) -> KVCache:
+    """Repack the slots named by ``idx`` (``[b'] int32``, b' <
+    max_batch) into a smaller cache — the device half of slot
+    compaction (``serve/engine.py``).  The slot dim must be UNSHARDED
+    (dp=1, enforced by ``ServingConfig.validate``): then the take is a
+    purely local gather and the compaction jit lowers to zero
+    collectives (audited — ``serve/engine.py::compact[tp]``)."""
+    return KVCache(
+        k=jnp.take(cache.k, idx, axis=1),
+        v=jnp.take(cache.v, idx, axis=1),
+        lengths=jnp.take(cache.lengths, idx, axis=0),
+    )
+
+
+def scatter_cache_slots(cache: KVCache, small: KVCache,
+                        idx: jax.Array) -> KVCache:
+    """Write a compacted cache's rows back into their big-batch slots
+    (inverse of :func:`gather_cache_slots`; ``idx`` rows must be
+    distinct — the engine pads the active-slot list with distinct FREE
+    slots, never duplicates, so the scatter is well-defined)."""
+    return KVCache(
+        k=cache.k.at[:, idx].set(small.k),
+        v=cache.v.at[:, idx].set(small.v),
+        lengths=cache.lengths.at[idx].set(small.lengths),
+    )
+
+
 class CacheOverflow(RuntimeError):
     """A slot used more blocks than were reserved for it — an engine bug
     (reservation-based admission makes this unreachable under load)."""

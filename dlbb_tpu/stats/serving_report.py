@@ -13,12 +13,14 @@ from typing import Any, Optional
 from dlbb_tpu.utils.config import atomic_write_text
 
 CSV_COLUMNS = (
-    "name", "trace", "requests", "completed", "rejected", "mesh",
+    "name", "trace", "requests", "completed", "rejected", "shed_rate",
+    "rej_queue_wait_ms", "mesh",
     "max_batch", "block_size", "max_seq",
     "goodput_tok_s", "throughput_tok_s",
     "ttft_p50_ms", "ttft_p99_ms", "ttft_p999_ms",
     "per_token_p50_ms", "per_token_p99_ms", "per_token_p999_ms",
     "peak_queue_depth", "peak_blocks_in_use", "decode_steps",
+    "fused_steps", "prefill_chunks",
     "wall_seconds",
 )
 
@@ -26,6 +28,28 @@ CSV_COLUMNS = (
 def _ms(summary: dict[str, Any], key: str) -> Optional[float]:
     v = summary.get(key)
     return None if v is None else round(float(v) * 1e3, 3)
+
+
+def _rejection_stats(req: dict[str, Any]) -> tuple[Optional[float],
+                                                   Optional[float]]:
+    """(shed_rate, mean queue-head wait at rejection in ms) — the
+    admission-tuning signals.  ``rejected_detail`` is absent from
+    pre-fast-path reports; both then fall back gracefully (shed rate
+    from the counters, wait to None)."""
+    arrived = req.get("arrived")
+    rejected = req.get("rejected")
+    shed = req.get("shed_rate")
+    if shed is None and arrived:
+        shed = (rejected or 0) / arrived
+    detail = req.get("rejected_detail")
+    wait_ms = None
+    if detail:
+        waits = [d["queue_wait_s"] for d in detail
+                 if d.get("reason") == "queue-full"
+                 and d.get("queue_wait_s") is not None]
+        if waits:
+            wait_ms = round(sum(waits) / len(waits) * 1e3, 3)
+    return (None if shed is None else round(shed, 4)), wait_ms
 
 
 def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
@@ -37,12 +61,18 @@ def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
     mesh = report.get("mesh", {})
     series = report.get("timeseries", {})
     serving = report.get("serving", {})
+    fast = report.get("fast_path", {})
+    shed_rate, rej_wait_ms = _rejection_stats(req)
     return {
         "name": name,
         "trace": report.get("trace", {}).get("kind"),
         "requests": report.get("trace", {}).get("num_requests"),
         "completed": req.get("completed"),
         "rejected": req.get("rejected"),
+        "shed_rate": shed_rate,
+        "rej_queue_wait_ms": rej_wait_ms,
+        "fused_steps": fast.get("fused_steps"),
+        "prefill_chunks": fast.get("prefill_chunks"),
         "mesh": "x".join(f"{k}{v}" for k, v in sorted(mesh.items())
                          if isinstance(v, int) and v > 1) or "1",
         "max_batch": serving.get("max_batch"),
@@ -97,17 +127,31 @@ def write_serving_report(results_dir: "str | Path",
         "(`python -m dlbb_tpu.cli serve`, docs/serving.md).  Goodput is "
         "completed-request output tokens per second; TTFT is "
         "arrival-to-first-token (queueing included); per-token latency "
-        "is the decode-step interval each resident request observed.",
+        "is the decode-step interval each resident request observed.  "
+        "Shed rate is queue-full rejections/arrived (infeasible "
+        "rejections are a config/trace mismatch and excluded); "
+        "\"rej wait\" is the mean time "
+        "the queue HEAD had been waiting when an arrival was shed "
+        "(high values = the queue bound is doing its job under real "
+        "backlog; near-zero = capacity is set too low) — the "
+        "admission-tuning signals (`requests.rejected_detail` carries "
+        "the per-rejection reason + wait).",
         "",
-        "| run | trace | req | done | rej | mesh | goodput tok/s | "
+        "| run | trace | req | done | rej | shed | rej wait ms | mesh | "
+        "goodput tok/s | "
         "TTFT p50/p99/p99.9 ms | tok p50/p99/p99.9 ms | peak queue | "
         "peak blocks |",
-        "|---|---|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
+        shed = ("-" if r["shed_rate"] is None
+                else f"{r['shed_rate'] * 100:.0f}%")
+        wait = ("-" if r["rej_queue_wait_ms"] is None
+                else r["rej_queue_wait_ms"])
         lines.append(
             f"| {r['name']} | {r['trace']} | {r['requests']} | "
-            f"{r['completed']} | {r['rejected']} | {r['mesh']} | "
+            f"{r['completed']} | {r['rejected']} | {shed} | {wait} | "
+            f"{r['mesh']} | "
             f"{r['goodput_tok_s']} | "
             f"{r['ttft_p50_ms']}/{r['ttft_p99_ms']}/{r['ttft_p999_ms']} | "
             f"{r['per_token_p50_ms']}/{r['per_token_p99_ms']}/"
@@ -116,4 +160,81 @@ def write_serving_report(results_dir: "str | Path",
         )
     lines.append("")
     atomic_write_text("\n".join(lines), out / "SERVING.md")
+    return rows
+
+
+def write_fastpath_report(bench_path: "str | Path",
+                          output_dir: "str | Path") -> list[dict[str, Any]]:
+    """The fast-path vs baseline comparison table: consolidate
+    ``BENCH_serve.json`` (``scripts/bench_serving.py`` — per-step vs
+    fused-K x compaction over the same replayed trace) into
+    ``FASTPATH.md``.  Returns the rows (empty when the bench artifact
+    is missing/unreadable — callers skip, never clobber)."""
+    bench_path = Path(bench_path)
+    try:
+        bench = json.loads(bench_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return []
+    settings = bench.get("settings", {})
+    if not settings:
+        return []
+    base_key = bench.get("baseline", "per_step")
+    rows = []
+    for name in settings:
+        s = settings[name]
+        tps = s.get("output_tokens_per_s", {})
+        med = tps.get("median")
+        # prefer the bench's own (within-mesh, within-trace) speedup;
+        # fall back to the global baseline for older artifacts
+        speedup = s.get("speedup_vs_per_step")
+        if speedup is None:
+            base = settings.get(s.get("baseline", base_key), {})
+            base_tps = base.get("output_tokens_per_s", {}).get("median")
+            speedup = (round(med / base_tps, 3)
+                       if med and base_tps else None)
+        rows.append({
+            "setting": name,
+            "baseline": s.get("baseline", base_key),
+            "trace": s.get("trace"),
+            "decode_horizon": s.get("decode_horizon"),
+            "compaction": s.get("compact_threshold") is not None,
+            "output_tok_s_median": med,
+            "output_tok_s_min": tps.get("min"),
+            "output_tok_s_max": tps.get("max"),
+            "per_token_p50_ms": s.get("per_token_p50_ms"),
+            "decode_units": s.get("decode_units"),
+            "speedup_vs_baseline": speedup,
+        })
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    lines = [
+        "# Decode fast path vs per-step baseline",
+        "",
+        f"Source: `{bench_path.name}` "
+        "(`scripts/bench_serving.py` — every setting replays the SAME "
+        "seeded trace as its baseline, settings interleaved within "
+        "each repetition so host drift cancels; medians of per-rep "
+        "throughput with min/max spread).  Throughput is generated "
+        "output tokens per wall second; each speedup is against the "
+        "per-step PR-9 engine on the SAME mesh and trace "
+        f"(default `{base_key}`).",
+        "",
+        "| setting | trace | K | compaction | out tok/s (min..max) | "
+        "tok p50 ms | decode units | speedup vs baseline |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        tps = ("-" if r["output_tok_s_median"] is None else
+               f"{r['output_tok_s_median']:.0f} "
+               f"({r['output_tok_s_min']:.0f}..{r['output_tok_s_max']:.0f})")
+        speed = ("-" if r["speedup_vs_baseline"] is None
+                 else f"{r['speedup_vs_baseline']:.2f}x")
+        lines.append(
+            f"| {r['setting']} | {r['trace'] or '-'} | "
+            f"{r['decode_horizon']} | "
+            f"{'on' if r['compaction'] else 'off'} | {tps} | "
+            f"{r['per_token_p50_ms']} | {r['decode_units']} | {speed} |"
+        )
+    lines.append("")
+    atomic_write_text("\n".join(lines), out / "FASTPATH.md")
     return rows

@@ -88,6 +88,21 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
     -m serve_smoke -p no:cacheprovider
 
+# serve_fastpath_smoke (docs/serving.md): the decode fast path's
+# equivalence contract — the per-step and fused-K engines must produce
+# IDENTICAL completed-token sequences on a seeded mini-trace (fused
+# scans, in-flight window, chunked prefill all engaged), with
+# schema-valid artifacts and the fast-path metrics counters present.
+# The HLO-side contract for the three new jit families (fused-scan
+# decode: trip-count-weighted tiny tp psums only; chunked prefill:
+# prefix-carry attention with zero cache reads across the slot shard;
+# compaction: zero collectives) is enforced by `analyze all` above via
+# the serve/engine.py::{decode_fused,prefill_chunk,compact_*} targets,
+# and `analyze diff` against the committed baselines makes a cache
+# regather inside the scan body a CI failure — zero suppressions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve_fastpath.py -q \
+    -m serve_fastpath_smoke -p no:cacheprovider
+
 # compressed-collective smoke (docs/compression.md): int8/fp8 allreduce_q
 # mini-sweep through the real engine + one compressed train step whose
 # losses track the uncompressed run — the HLO-side compression proof

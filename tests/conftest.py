@@ -79,6 +79,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "serve_fastpath_smoke: decode fast-path smoke — per-step and "
+        "fused-K engines must produce identical completed-token "
+        "sequences on a seeded mini-trace, with schema-valid artifacts "
+        "(tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )

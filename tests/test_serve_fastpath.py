@@ -1,0 +1,536 @@
+"""Decode fast-path tests (``docs/serving.md``): fused multi-step
+decode / chunked prefill / host-overlap window / slot compaction.
+
+The load-bearing contract is EQUIVALENCE: every fast-path configuration
+must produce the identical completed-token sequences (argmax over each
+generated output) as the PR-9 per-step engine on the same trace — the
+fast path buys dispatches, never different results.  On top of that,
+the scheduler edge cases the fast path makes reachable: completion
+mid-fused-scan (masked slot stays dead, blocks free at scan exit),
+admission arriving during an in-flight window, and a K horizon that
+overshoots every remaining output length.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dlbb_tpu.comm.mesh import build_parallelism_mesh
+from dlbb_tpu.models.configs import ModelConfig
+from dlbb_tpu.serve.engine import ServingConfig, ServingEngine
+from dlbb_tpu.serve.traffic import Request, TrafficTrace, generate_trace
+
+TINY = dict(hidden_size=64, num_layers=2, num_heads=4,
+            ffn_intermediate=128, dtype="float32", attention="full")
+MODEL = ModelConfig(**TINY)
+SERVE = dict(max_batch=8, block_size=8, max_seq=64, hbm_budget_gb=None)
+
+
+def _trace(reqs):
+    return TrafficTrace(kind="poisson", seed=0, params={},
+                        requests=tuple(reqs))
+
+
+@pytest.fixture(scope="module")
+def baseline_engine(mesh2x4):
+    """The per-step PR-9 engine — every equivalence test's oracle."""
+    return ServingEngine(MODEL, ServingConfig(**SERVE), mesh2x4,
+                         verbose=False, capture_tokens=True)
+
+
+@pytest.fixture(scope="module")
+def fast_engine(mesh2x4):
+    """The full fast path: fused scans (K<=16), in-flight window 2,
+    chunked prefill (8-token chunks)."""
+    return ServingEngine(
+        MODEL,
+        ServingConfig(**SERVE, decode_horizon=16, inflight_window=2,
+                      prefill_chunk=8),
+        mesh2x4, verbose=False, capture_tokens=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+
+def test_fastpath_config_validation():
+    with pytest.raises(ValueError, match="decode_horizon"):
+        ServingConfig(**SERVE, decode_horizon=0).validate(MODEL)
+    with pytest.raises(ValueError, match="inflight_window"):
+        ServingConfig(**SERVE, inflight_window=0).validate(MODEL)
+    # a window without fused scans would be a silent no-op (k=1 units
+    # never stay in flight)
+    with pytest.raises(ValueError, match="inflight_window"):
+        ServingConfig(**SERVE, inflight_window=2).validate(MODEL)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingConfig(**SERVE, prefill_chunk=12).validate(MODEL)
+    # the chunk must divide max_seq: chunk-rounding a near-max_seq
+    # prompt must never overrun the slot's block ring
+    with pytest.raises(ValueError, match="divide"):
+        ServingConfig(max_batch=8, block_size=8, max_seq=40,
+                      hbm_budget_gb=None,
+                      prefill_chunk=16).validate(MODEL)
+    with pytest.raises(ValueError, match="compact_threshold"):
+        ServingConfig(**SERVE, compact_threshold=0.9).validate(MODEL)
+    # compaction without fused scans would be a silent no-op
+    with pytest.raises(ValueError, match="decode_horizon"):
+        ServingConfig(**SERVE, compact_threshold=0.5).validate(MODEL)
+    # compaction demands an unsharded slot dim
+    with pytest.raises(ValueError, match="dp=1"):
+        ServingConfig(**SERVE, decode_horizon=16,
+                      compact_threshold=0.5).validate(MODEL, dp=2, tp=4)
+    # the power-of-two fused bucket ladder
+    assert ServingConfig(**SERVE).fused_horizons == ()
+    assert ServingConfig(**SERVE,
+                         decode_horizon=16).fused_horizons == (2, 4, 8, 16)
+    # round trip keeps the fast-path knobs
+    sv = ServingConfig(**SERVE, decode_horizon=4, prefill_chunk=8,
+                       reject_infeasible=True)
+    rt = ServingConfig.from_dict(sv.to_dict())
+    assert rt.decode_horizon == 4 and rt.prefill_chunk == 8
+    assert rt.reject_infeasible is True
+
+
+# ---------------------------------------------------------------------------
+# the equivalence contract (serve_fastpath_smoke)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve_fastpath_smoke
+def test_fused_engine_matches_per_step_tokens(baseline_engine,
+                                              fast_engine):
+    """The CI gate: the full fast path (fused scans + window + chunked
+    prefill) serves the seeded mini-trace with completed-token
+    sequences IDENTICAL to the per-step engine's, token for token."""
+    trace = generate_trace("poisson", 24, seed=7, rate=500.0,
+                           prompt_range=(4, 20), output_range=(2, 12))
+    base = baseline_engine.run_trace(trace)
+    fast = fast_engine.run_trace(trace)
+    assert base["requests"]["completed"] == 24
+    assert fast["requests"]["completed"] == 24
+    assert base["completed_tokens"] == fast["completed_tokens"]
+    # every request produced exactly its output_len tokens
+    for r in trace:
+        assert len(fast["completed_tokens"][str(r.rid)]) == r.output_len
+    # the fast path actually engaged
+    fp = fast["fast_path"]
+    assert fp["enabled"] and fp["fused_scans"] > 0
+    assert fp["prefill_chunks"] > 0
+    assert fast["decode_units"] < fast["decode_steps"]
+    # per-step engine: one dispatch per step, nothing fused
+    assert base["fast_path"]["fused_scans"] == 0
+    assert base["decode_units"] == base["decode_steps"]
+
+
+@pytest.mark.serve_fastpath_smoke
+def test_fastpath_artifact_set_schema_valid(tmp_path):
+    """serve/bench.py with fast-path overrides: the artifact set stays
+    schema-valid and records the fast-path counters."""
+    from dlbb_tpu.serve.bench import run_serving
+
+    config = {
+        "experiment": {"name": "fastsmoke"},
+        "model": dict(TINY),
+        "parallelism": {"data_parallel": 2, "world_size": 4},
+        "serving": {**SERVE, "decode_horizon": 8, "inflight_window": 2},
+    }
+    trace = generate_trace("poisson", 6, seed=9, rate=500.0,
+                           prompt_range=(4, 16), output_range=(4, 10))
+    report = run_serving(config, trace, str(tmp_path), verbose=False)
+    assert report["requests"]["completed"] == 6
+    result = json.loads((tmp_path / "serving_fastsmoke.json").read_text())
+    assert result["schema"] == "dlbb_serving_report_v1"
+    assert result["fast_path"]["decode_horizon"] == 8
+    assert result["serving"]["decode_horizon"] == 8
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "dlbb_serve_decode_steps_total" in prom
+    assert "dlbb_serve_fused_scan_steps_total" in prom
+    assert "dlbb_serve_prefill_chunks_total" in prom
+    assert "dlbb_serve_decode_batch_occupancy" in prom
+
+
+# ---------------------------------------------------------------------------
+# scheduler edge cases the fast path makes reachable
+# ---------------------------------------------------------------------------
+
+
+def test_completion_mid_fused_scan(baseline_engine, mesh2x4):
+    """A slot whose request completes mid-scan is masked inactive for
+    the remaining trips: it receives EXACTLY output_len tokens, its
+    cache stops advancing, and its blocks free at scan exit."""
+    engine = ServingEngine(
+        MODEL, ServingConfig(**SERVE, decode_horizon=8), mesh2x4,
+        verbose=False, capture_tokens=True,
+    )
+    # both resident from t=0; nothing pending/queued after admission, so
+    # the horizon is max(remaining) and the scan overshoots rid 0
+    trace = _trace([
+        Request(rid=0, arrival_s=0.0, prompt_len=6, output_len=3,
+                seed=11),
+        Request(rid=1, arrival_s=0.0, prompt_len=6, output_len=12,
+                seed=12),
+    ])
+    report = engine.run_trace(trace)
+    base = baseline_engine.run_trace(trace)
+    assert report["completed_tokens"] == base["completed_tokens"]
+    assert len(report["completed_tokens"]["0"]) == 3
+    assert len(report["completed_tokens"]["1"]) == 12
+    # a fused scan ran past rid 0's completion
+    assert report["fast_path"]["fused_steps"] >= 8
+    # scan exit freed everything
+    assert report["cache"]["blocks_reserved"] == 0
+    assert report["requests"]["completed"] == 2
+
+
+def test_admission_during_inflight_window(baseline_engine, mesh2x4):
+    """An arrival landing while decode units are in flight is admitted
+    at the next scan boundary (the engine drains the window before the
+    prefill, keeping TTFT honest) and the tokens stay identical."""
+    engine = ServingEngine(
+        MODEL, ServingConfig(**SERVE, decode_horizon=4,
+                             inflight_window=3),
+        mesh2x4, verbose=False, capture_tokens=True,
+    )
+    trace = _trace([
+        Request(rid=0, arrival_s=0.0, prompt_len=8, output_len=24,
+                seed=21),
+        Request(rid=1, arrival_s=0.0, prompt_len=8, output_len=24,
+                seed=22),
+        # lands mid-decode: the per-step run takes ~24 steps to drain
+        Request(rid=2, arrival_s=0.05, prompt_len=8, output_len=8,
+                seed=23),
+    ])
+    report = engine.run_trace(trace)
+    base = baseline_engine.run_trace(trace)
+    assert report["requests"]["completed"] == 3
+    assert report["completed_tokens"] == base["completed_tokens"]
+    assert report["fast_path"]["fused_scans"] > 0
+
+
+def test_k_horizon_overshoots_every_remaining_length(mesh2x4):
+    """decode_horizon far beyond every remaining output: the fused
+    bucket clamps to the drain horizon, masked trips never generate
+    tokens past output_len, and the ledger never overflows."""
+    engine = ServingEngine(
+        MODEL, ServingConfig(**SERVE, decode_horizon=64), mesh2x4,
+        verbose=False, capture_tokens=True,
+    )
+    trace = _trace([
+        Request(rid=0, arrival_s=0.0, prompt_len=4, output_len=3,
+                seed=31),
+        Request(rid=1, arrival_s=0.0, prompt_len=4, output_len=5,
+                seed=32),
+    ])
+    report = engine.run_trace(trace)
+    assert report["requests"]["completed"] == 2
+    assert len(report["completed_tokens"]["0"]) == 3
+    assert len(report["completed_tokens"]["1"]) == 5
+    # the scan ladder never dispatched more trips than the longest
+    # remaining output (prefill already produced token 1 of each)
+    assert report["decode_steps"] == 4
+    assert report["cache"]["blocks_reserved"] == 0
+
+
+def test_compaction_engine_equivalence():
+    """Slot compaction (dp=1): fused scans on the gather-compacted half
+    batch produce the same tokens; compacted_scans counts the variant's
+    engagements."""
+    mesh = build_parallelism_mesh(tensor_parallel=4,
+                                  devices=jax.devices()[:4])
+    trace = generate_trace("poisson", 8, seed=13, rate=500.0,
+                           prompt_range=(4, 16), output_range=(6, 20))
+    base = ServingEngine(MODEL, ServingConfig(**SERVE), mesh,
+                         verbose=False, capture_tokens=True)
+    comp = ServingEngine(
+        MODEL, ServingConfig(**SERVE, decode_horizon=16,
+                             compact_threshold=0.5),
+        mesh, verbose=False, capture_tokens=True,
+    )
+    rb = base.run_trace(trace)
+    rc = comp.run_trace(trace)
+    assert rb["completed_tokens"] == rc["completed_tokens"]
+    assert rc["fast_path"]["compacted_scans"] > 0
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill: program-level equivalence
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_prefill_matches_monolithic(mesh2x4):
+    """Chunk-by-chunk prefill writes the identical cache and returns
+    the identical last-token output as the monolithic bucketed
+    prefill (the offset-causal prefix-carry attention is the same
+    math)."""
+    from dlbb_tpu.models.transformer import init_params_sharded
+    from dlbb_tpu.serve.engine import (
+        build_prefill,
+        build_prefill_chunk,
+        create_prefix,
+    )
+    from dlbb_tpu.serve.kvcache import create_kv_cache
+
+    params = init_params_sharded(MODEL, jax.random.key(0), mesh2x4)
+    prompt, slot, chunk = 19, 1, 8
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal(
+        (1, 24, MODEL.hidden_size)).astype(np.float32))
+
+    sv = ServingConfig(**SERVE)
+    cache_a = create_kv_cache(MODEL, sv.max_batch, sv.num_blocks,
+                              sv.block_size, mesh=mesh2x4)
+    bucket = sv.bucket_for(prompt)
+    xa = jnp.zeros((1, bucket, MODEL.hidden_size),
+                   jnp.float32).at[:, :prompt].set(x[:, :prompt])
+    cache_a, ya = build_prefill(MODEL, mesh2x4)(
+        cache_a, params, xa, np.int32(slot), np.int32(prompt))
+
+    cache_b = create_kv_cache(MODEL, sv.max_batch, sv.num_blocks,
+                              sv.block_size, mesh=mesh2x4)
+    prefix = create_prefix(MODEL, mesh2x4)
+    n_chunks = -(-prompt // chunk)
+    xb = jnp.zeros((1, n_chunks * chunk, MODEL.hidden_size),
+                   jnp.float32).at[:, :prompt].set(x[:, :prompt])
+    for ci in range(n_chunks):
+        jit = build_prefill_chunk(MODEL, mesh2x4, chunk, ci * chunk)
+        cache_b, prefix, yb = jit(
+            cache_b, prefix, params, xb[:, ci * chunk:(ci + 1) * chunk],
+            np.int32(slot), np.int32(prompt))
+
+    assert float(jnp.abs(ya - yb).max()) <= 1e-5
+    ka = np.asarray(cache_a.k)[:, slot].reshape(
+        MODEL.num_layers, -1, MODEL.kv_heads, MODEL.head_dim)[:, :prompt]
+    kb = np.asarray(cache_b.k)[:, slot].reshape(
+        MODEL.num_layers, -1, MODEL.kv_heads, MODEL.head_dim)[:, :prompt]
+    assert float(np.abs(ka - kb).max()) <= 1e-5
+    assert int(cache_b.lengths[slot]) == prompt
+    assert int(cache_b.lengths[0]) == 0
+
+
+# ---------------------------------------------------------------------------
+# rejection detail + journal reasons (admission-tuning satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_rejection_detail_and_shed_rate(baseline_engine):
+    """Queue-full rejections carry the queue head's wait time (how
+    backed up admission was when load was shed) and the report exposes
+    the shed rate."""
+    from dataclasses import replace
+
+    engine = baseline_engine
+    trace = generate_trace("poisson", 12, seed=3, rate=5000.0,
+                           prompt_range=(4, 16), output_range=(4, 8))
+    original = engine.serving
+    engine.serving = replace(original, queue_capacity=1)
+    try:
+        report = engine.run_trace(trace)
+    finally:
+        engine.serving = original
+    req = report["requests"]
+    assert req["rejected"] > 0
+    detail = req["rejected_detail"]
+    assert len(detail) == req["rejected"]
+    assert all(d["reason"] == "queue-full" for d in detail)
+    assert all(d["queue_wait_s"] >= 0.0 for d in detail)
+    assert req["shed_rate"] == pytest.approx(
+        req["rejected"] / req["arrived"])
+    assert req["rejected_rids"] == [d["rid"] for d in detail]
+
+
+def test_infeasible_rejected_and_journaled_distinctly(mesh2x4, tmp_path):
+    """reject_infeasible: an unservable request is shed at arrival with
+    reason="infeasible" — a DISTINCT journal event from queue-full —
+    while the feasible rest of the trace completes."""
+    from dlbb_tpu.obs import spans
+    from dlbb_tpu.resilience.journal import SweepJournal, read_journal
+
+    engine = ServingEngine(
+        MODEL, ServingConfig(**SERVE, reject_infeasible=True), mesh2x4,
+        verbose=False,
+    )
+    trace = _trace([
+        Request(rid=0, arrival_s=0.0, prompt_len=8, output_len=4,
+                seed=1),
+        # prompt + output outgrows max_seq: infeasible, not load
+        Request(rid=1, arrival_s=0.0, prompt_len=40, output_len=30,
+                seed=2),
+    ])
+    journal = SweepJournal(tmp_path, meta={"mode": "serve"},
+                           sink=spans.journal_sink)
+    engine.journal = journal
+    try:
+        report = engine.run_trace(trace)
+    finally:
+        engine.journal = None
+        journal.close()
+    req = report["requests"]
+    assert req["completed"] == 1 and req["rejected"] == 1
+    assert req["rejected_detail"][0]["reason"] == "infeasible"
+    assert "max_seq" in req["rejected_detail"][0]["detail"]
+    # infeasible is a config mismatch, never LOAD: not in the shed rate
+    assert req["shed_rate"] == 0.0
+    events, torn = read_journal(tmp_path)
+    assert torn == 0
+    kinds = {e["event"] for e in events}
+    assert "request-infeasible" in kinds
+    assert "request-rejected" not in kinds  # no load was shed
+    # the reason-labelled counter split the two paths
+    assert engine.registry.get("serve_rejections",
+                               reason="infeasible") >= 1
+    # journal -> timeline: the infeasible rejection still closes the
+    # request's arrived->end span
+    timeline, _n, torn2 = spans.journal_to_trace(
+        tmp_path, tmp_path / "timeline.json")
+    assert torn2 == 0
+    rebuilt = spans.load_trace(timeline)
+    infeasible_spans = [e for e in rebuilt["traceEvents"]
+                        if e["ph"] == "X"
+                        and e["cat"] == "config-infeasible"]
+    assert len(infeasible_spans) == 1
+    # the strict default still fails the whole trace up front
+    with pytest.raises(ValueError, match="max_seq"):
+        ServingEngine(MODEL, ServingConfig(**SERVE), mesh2x4,
+                      verbose=False).run_trace(trace)
+
+
+# ---------------------------------------------------------------------------
+# span-trace fidelity (one span per scan) + journal timelines
+# ---------------------------------------------------------------------------
+
+
+def test_fused_scan_emits_one_span_with_steps_attr(mesh2x4, tmp_path):
+    """A fused K-step scan is ONE ``serve-decode`` span carrying a
+    ``steps`` attribute — not K fake per-step spans — and the journal
+    timeline stays correct when several requests complete inside one
+    host iteration."""
+    from dlbb_tpu.obs import spans
+    from dlbb_tpu.resilience.journal import SweepJournal, read_journal
+
+    engine = ServingEngine(
+        MODEL, ServingConfig(**SERVE, decode_horizon=8), mesh2x4,
+        verbose=False,
+    )
+    trace = _trace([
+        Request(rid=i, arrival_s=0.0, prompt_len=6, output_len=6,
+                seed=40 + i)
+        for i in range(4)
+    ])
+    span_path = tmp_path / "trace.json"
+    journal = SweepJournal(tmp_path, meta={"mode": "serve"},
+                           sink=spans.journal_sink)
+    engine.journal = journal
+    try:
+        with spans.tracing(span_path):
+            report = engine.run_trace(trace)
+    finally:
+        engine.journal = None
+        journal.close()
+    payload = spans.load_trace(span_path)
+    assert spans.validate_trace_events(payload["traceEvents"]) == []
+    decode_begins = [e for e in payload["traceEvents"]
+                     if e["ph"] == "B" and e["name"] == "serve-decode"]
+    # one span per dispatched unit, scans included
+    assert len(decode_begins) == report["decode_units"]
+    fused = [e for e in decode_begins if e["args"]["steps"] > 1]
+    assert len(fused) == report["fast_path"]["fused_scans"]
+    assert sum(e["args"]["steps"] for e in decode_begins) == \
+        report["decode_steps"]
+    # all four requests completed in ONE host iteration (same scan);
+    # the journal still pairs every lifecycle span
+    events, torn = read_journal(tmp_path)
+    assert torn == 0
+    completed = [e for e in events if e["event"] == "request-completed"]
+    assert len(completed) == 4
+    timeline, _n, torn2 = spans.journal_to_trace(
+        tmp_path, tmp_path / "timeline.json")
+    assert torn2 == 0
+    rebuilt = spans.load_trace(timeline)
+    req_spans = [e for e in rebuilt["traceEvents"] if e["ph"] == "X"]
+    assert len(req_spans) == 4
+    assert all(e["cat"] == "config-completed" for e in req_spans)
+
+
+# ---------------------------------------------------------------------------
+# report writers
+# ---------------------------------------------------------------------------
+
+
+def test_serving_report_shed_columns(tmp_path):
+    from dlbb_tpu.stats.serving_report import write_serving_report
+    from dlbb_tpu.utils.config import save_json
+
+    fake = {
+        "schema": "dlbb_serving_report_v1",
+        "trace": {"kind": "poisson", "num_requests": 10},
+        "requests": {"arrived": 10, "completed": 8, "rejected": 2,
+                     "shed_rate": 0.2,
+                     "rejected_detail": [
+                         {"rid": 4, "reason": "queue-full",
+                          "queue_depth": 3, "queue_wait_s": 0.05},
+                         {"rid": 7, "reason": "queue-full",
+                          "queue_depth": 3, "queue_wait_s": 0.15},
+                     ]},
+        "mesh": {"dp": 2, "tp": 4},
+        "serving": {"max_batch": 8, "block_size": 16, "max_seq": 256},
+        "fast_path": {"fused_steps": 64, "prefill_chunks": 5},
+        "goodput_tokens_per_s": 100.0,
+        "ttft": {"median": 0.01, "p99": 0.02, "p999": 0.03},
+        "per_token_latency": {"median": 0.001, "p99": 0.002,
+                              "p999": 0.003},
+        "cache": {"peak_blocks_in_use": 12},
+        "timeseries": {"queue_depth": [0, 3]},
+        "decode_steps": 42,
+        "wall_seconds": 1.5,
+    }
+    results = tmp_path / "results"
+    save_json(fake, results / "serving_fastrun.json")
+    rows = write_serving_report(results, tmp_path / "stats")
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["shed_rate"] == 0.2
+    assert row["rej_queue_wait_ms"] == 100.0  # mean of 50 and 150
+    assert row["fused_steps"] == 64
+    md = (tmp_path / "stats" / "SERVING.md").read_text()
+    assert "20%" in md and "100.0" in md
+
+
+def test_fastpath_report_writer(tmp_path):
+    from dlbb_tpu.stats.serving_report import write_fastpath_report
+    from dlbb_tpu.utils.config import save_json
+
+    bench = {
+        "schema": "dlbb_bench_serve_v1",
+        "baseline": "per_step",
+        "settings": {
+            "per_step": {
+                "decode_horizon": 1,
+                "output_tokens_per_s": {"median": 100.0, "min": 95.0,
+                                        "max": 105.0},
+                "per_token_p50_ms": 10.0, "decode_units": 200,
+            },
+            "fused_k16": {
+                "decode_horizon": 16,
+                "output_tokens_per_s": {"median": 250.0, "min": 240.0,
+                                        "max": 260.0},
+                "per_token_p50_ms": 4.0, "decode_units": 20,
+            },
+        },
+    }
+    path = tmp_path / "BENCH_serve.json"
+    save_json(bench, path)
+    rows = write_fastpath_report(path, tmp_path / "stats")
+    assert len(rows) == 2
+    by_name = {r["setting"]: r for r in rows}
+    assert by_name["fused_k16"]["speedup_vs_baseline"] == 2.5
+    assert by_name["per_step"]["speedup_vs_baseline"] == 1.0
+    md = (tmp_path / "stats" / "FASTPATH.md").read_text()
+    assert "2.50x" in md and "fused_k16" in md
+    # missing artifact: no rows, nothing clobbered
+    assert write_fastpath_report(tmp_path / "nope.json",
+                                 tmp_path / "stats2") == []
