@@ -534,6 +534,15 @@ def _run_sweep_configured(
     # counters below are registry-backed, so the manifest's `configs`
     # section and the metrics.prom textfile export come from one source
     metrics = MetricsRegistry()
+    # a degraded-probe fallback is a FIRST-CLASS event (ROADMAP standing
+    # chore): its own journal record + Prometheus counter, so `obs
+    # trace` timelines and scrapes both see it — not just a field
+    # buried in the topology record
+    metrics.inc("sweep_degraded", 1 if topology["degraded"] else 0,
+                help="sweeps measured on a degraded (fallback) backend")
+    if topology["degraded"]:
+        journal.event("degraded",
+                      reason=topology.get("degraded_reason"))
     # every counter counts CONFIGS (a skipped rank count skips one whole
     # grid of them), so planned+skipped+resumed+failed adds up
     # (resume_invalid configs re-run, so they also land in

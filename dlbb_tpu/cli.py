@@ -235,7 +235,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ch.add_argument("--plan", default="all",
                     help="fault class to exercise (compile, transient, "
-                         "nan, torn, hang, ckpt, preempt, kill) or 'all'")
+                         "nan, torn, hang, ckpt, preempt, kill, serve) "
+                         "or 'all'")
     ch.add_argument("--simulate", type=int, default=8, metavar="N",
                     help="CPU-simulated mesh size (default 8; the gate "
                          "needs no TPU)")
@@ -294,6 +295,36 @@ def build_parser() -> argparse.ArgumentParser:
                     help="occupancy fraction (0, 0.5] at or below which "
                          "fused scans run on a gather-compacted half "
                          "batch (dp=1 meshes only; default: off)")
+    sv.add_argument("--slo", type=float, default=None, metavar="SEC",
+                    help="per-request deadline (SLO) stamped on every "
+                         "generated request: queued requests whose wait "
+                         "already blew it are shed "
+                         "(request-rejected[reason=deadline]) and "
+                         "completions past it are counted "
+                         "(docs/serving.md)")
+    sv.add_argument("--dispatch-retries", type=int, default=None,
+                    dest="max_dispatch_retries",
+                    help="bounded retries for a transiently-failed "
+                         "prefill/decode dispatch (default 2; host "
+                         "state rolls back to the pre-dispatch "
+                         "snapshot before each retry)")
+    sv.add_argument("--dispatch-deadline-factor", type=float,
+                    default=None, dest="dispatch_deadline_factor",
+                    help="arm the in-flight dispatch watchdog: abandon "
+                         "a decode unit exceeding FACTOR x K x the "
+                         "per-step EMA (requests journaled "
+                         "request-failed[reason=hung-dispatch]; "
+                         "default: off)")
+    sv.add_argument("--fault-plan", default=None, metavar="PLAN",
+                    help="deterministic fault-injection plan for the "
+                         "serving chaos harness (e.g. "
+                         "'serve-decode-fail:1'; DLBB_FAULT_PLAN env "
+                         "is the default; docs/resilience.md)")
+    sv.add_argument("--resume", action="store_true",
+                    help="finish a preempted serving run from the "
+                         "serving_resume.json checkpoint in --output: "
+                         "replays the remaining trace and merges both "
+                         "sessions into the final artifact set")
     sv.add_argument("--output", default=None,
                     help="output directory (default results/serving)")
     sv.add_argument("--simulate", type=int, default=0, metavar="N")
@@ -674,9 +705,22 @@ def _dispatch(args) -> int:
                 "inflight_window": args.inflight_window,
                 "prefill_chunk": args.prefill_chunk,
                 "compact_threshold": args.compact_threshold,
+                "max_dispatch_retries": args.max_dispatch_retries,
+                "dispatch_deadline_factor":
+                    args.dispatch_deadline_factor,
             },
+            resume=args.resume,
+            fault_plan=args.fault_plan,
+            slo=args.slo,
         )
         req = result["requests"]
+        if result.get("preempted"):
+            print(
+                f"preempted after {req['completed']} completed "
+                f"request(s); {len(result['remaining_rids'])} remain — "
+                "finish with `serve --resume`"
+            )
+            return 0
         print(
             f"goodput {result['goodput_tokens_per_s']:.0f} tok/s over "
             f"{req['completed']} completed / {req['rejected']} rejected "

@@ -235,6 +235,35 @@ def serving_metrics(report: dict[str, Any],
     if shed is not None:
         registry.set_gauge("serve_shed_rate", shed,
                            help="rejected / arrived requests this run")
+    req = report.get("requests", {})
+    for key, metric, hlp in (
+        ("deadline_shed", "serve_deadline_shed",
+         "queued requests shed because their SLO deadline passed"),
+        ("completed_past_deadline", "serve_completed_past_deadline",
+         "requests served to completion but past their SLO deadline"),
+        ("failed", "serve_failed_requests",
+         "requests failed closed (dispatch failure / hung dispatch)"),
+        ("preempted", "serve_preempted_requests",
+         "in-flight requests preempted by a graceful drain"),
+    ):
+        if key in req:
+            registry.set_gauge(metric, req[key], help=hlp)
+    # resilience counters live in the engine registry during the run
+    # (serve_request_retries / serve_hung_dispatches /
+    # serve_deadline_exceeded); when folding a bare report into a
+    # fresh registry, seed the totals so the export is self-contained
+    res = report.get("resilience", {})
+    if res and all(registry.get("serve_request_retries", phase=p) == 0
+                   for p in ("decode", "prefill", "bookkeeping")):
+        registry.inc("serve_request_retries", res.get("retries", 0),
+                     phase="decode",
+                     help="transient dispatch/bookkeeping retries, "
+                          "by phase")
+    if res and registry.get("serve_hung_dispatches") == 0:
+        registry.inc("serve_hung_dispatches",
+                     res.get("hung_dispatches", 0),
+                     help="decode units abandoned by the dispatch "
+                          "watchdog")
     for metric, key in (("serve_ttft_seconds", "ttft"),
                         ("serve_per_token_seconds", "per_token_latency")):
         summary = report.get(key, {})

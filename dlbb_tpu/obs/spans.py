@@ -296,8 +296,11 @@ def journal_to_trace(journal_dir: "str | Path",
     complete ("X") span — so even a sweep that crashed before writing its
     span trace yields a loadable Perfetto timeline from the fsync'd
     journal.  Serving journals (``serve/engine.py``) pair the same way:
-    ``request-arrived`` -> ``request-completed``/``request-rejected``
-    becomes each request's end-to-end span (queueing included).
+    ``request-arrived`` -> ``request-completed``/``request-rejected``/
+    ``request-failed``/``request-preempted`` becomes each request's
+    end-to-end span (queueing included) — failed and preempted
+    lifecycles stay debuggable from the journal alone, exactly as
+    completed ones do.
     Returns ``(path, events_converted, torn_lines)``."""
     from dlbb_tpu.resilience.journal import read_journal
     from dlbb_tpu.utils.config import atomic_write_text
@@ -319,7 +322,8 @@ def journal_to_trace(journal_dir: "str | Path",
         if name in ("started", "request-arrived") and config:
             open_configs[config] = ts_us
         elif (name in ("completed", "failed", "request-completed",
-                       "request-rejected", "request-infeasible")
+                       "request-rejected", "request-infeasible",
+                       "request-failed", "request-preempted")
               and config in open_configs):
             start_us = open_configs.pop(config)
             kind = name[len("request-"):] if name.startswith(

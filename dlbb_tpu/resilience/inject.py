@@ -65,6 +65,31 @@ hosts it):
                     preemption path; the installed handler must turn it
                     into a journaled stop + final save)
 ==================  =====================================================
+
+Serving sites (``serve/engine.py``; all fire strictly on the HOST side
+of a dispatch boundary — the jitted prefill/decode programs are
+byte-identical with or without a plan, pinned statically by
+``tests/test_serve_resilience.py``):
+
+=====================  ==================================================
+``serve-prefill-fail`` prefill dispatch boundary — raises
+                       :class:`TransientFault` BEFORE the jit is
+                       invoked (retry re-dispatches; the donated cache
+                       was never consumed)
+``serve-decode-fail``  decode-unit dispatch boundary — same contract
+``serve-decode-hang``  decode-unit dispatch — sleeps ``hang_seconds``
+                       (the in-flight-window watchdog must abandon it)
+``serve-cache-torn``   host ledger/slot bookkeeping after a decode
+                       unit — raises mid-loop, leaving the accounting
+                       torn (rollback to the pre-dispatch snapshot must
+                       recover; the device result is unaffected)
+``serve-trace-corrupt`` ``serve/traffic.TrafficTrace.load`` — truncates
+                       the trace text before parsing (load must fail
+                       closed with a clear chained error)
+``serve-preempt``      serving scheduler loop boundary — SIGTERMs own
+                       process (graceful drain + checkpoint +
+                       ``cli serve --resume``)
+=====================  ==================================================
 """
 
 from __future__ import annotations
@@ -106,6 +131,12 @@ SITES: tuple[str, ...] = (
     "kill-mid-write",
     "ckpt-corrupt",
     "preempt",
+    "serve-prefill-fail",
+    "serve-decode-fail",
+    "serve-decode-hang",
+    "serve-cache-torn",
+    "serve-trace-corrupt",
+    "serve-preempt",
 )
 
 _DEFAULT_PARAMS = {

@@ -13,11 +13,26 @@ and every artifact is an atomic write:
 - ``metrics.prom``          — Prometheus textfile
   (``obs.export.serving_metrics``);
 - ``sweep_journal.jsonl``   — request lifecycle audit trail.
+
+Graceful drain + deterministic resume (docs/resilience.md): a SIGTERM
+mid-trace stops admission, drains the in-flight window, and writes
+``serving_resume.json`` — the queue/trace-cursor checkpoint (remaining
+rids + the partial report with raw latency samples) next to the full
+replayable trace.  ``cli serve --resume`` replays the remaining
+requests (arrivals rebased, original gaps preserved) and MERGES the two
+sessions into the final artifact set, so it matches an uninterrupted
+run: same artifact names, same report schema, and the same per-request
+outcomes for every non-preempted request — the invariant
+``cli chaos --plan serve`` pins.  The checkpoint is deleted once the
+merged artifacts land; an incomplete session never writes
+``serving_<name>.json``.
 """
 
 from __future__ import annotations
 
+import json
 import time
+from dataclasses import replace
 from pathlib import Path
 from typing import Any, Optional, Sequence
 
@@ -26,6 +41,8 @@ from dlbb_tpu.serve.engine import ServingConfig, ServingEngine
 from dlbb_tpu.serve.traffic import TRACE_KINDS, TrafficTrace, generate_trace
 
 SERVING_MANIFEST_SCHEMA = "dlbb_serving_manifest_v1"
+SERVING_RESUME_SCHEMA = "dlbb_serving_resume_v1"
+RESUME_CHECKPOINT = "serving_resume.json"
 
 # The CLI's default model when no --config YAML is given: small enough
 # that a 100-request trace serves in seconds on the CPU-simulated mesh,
@@ -59,6 +76,7 @@ def resolve_trace(
     seed: int = 42,
     rate: Optional[float] = None,
     serving: Optional[ServingConfig] = None,
+    deadline_s: Optional[float] = None,
     **params: Any,
 ) -> TrafficTrace:
     """``--trace`` semantics: a known kind generates a seeded trace
@@ -69,6 +87,8 @@ def resolve_trace(
     kw: dict[str, Any] = dict(params)
     if rate is not None:
         kw["rate"] = rate
+    if deadline_s is not None:
+        kw["deadline_s"] = deadline_s
     if serving is not None and "prompt_range" not in kw:
         # bound sampled lengths so every request fits the envelope:
         # prompt within the largest bucket, and max_prompt + max_out <=
@@ -96,17 +116,30 @@ def run_serving(
     devices: Optional[Sequence] = None,
     journal: bool = True,
     verbose: bool = True,
+    fault_plan: Optional[str] = None,
+    collect_raw: bool = False,
 ) -> dict[str, Any]:
     """Run one trace-driven serving benchmark.
 
     ``config`` follows the experiment-YAML schema with a ``serving:``
     section next to ``model:`` and ``parallelism:`` (world_size = tp,
     data_parallel = dp).  Returns the report dict; when ``output_dir``
-    is set, writes the artifact set listed in the module docstring."""
+    is set, writes the artifact set listed in the module docstring.
+
+    ``fault_plan`` activates the chaos harness for the run (an
+    explicit plan wins; else an already-active plan is left alone;
+    else ``DLBB_FAULT_PLAN`` — the sweep engine's contract).  A
+    SIGTERM mid-trace (or the ``serve-preempt`` site) drains
+    gracefully and writes the ``serving_resume.json`` checkpoint
+    instead of the result artifact — see :func:`resume_serving`."""
+    import os
+
     from dlbb_tpu.obs import spans
     from dlbb_tpu.obs.export import serving_metrics
     from dlbb_tpu.parallel.plan import ParallelismPlan
+    from dlbb_tpu.resilience import inject
     from dlbb_tpu.resilience.journal import SweepJournal
+    from dlbb_tpu.resilience.preempt import PreemptionGuard
     from dlbb_tpu.utils.config import save_json
     from dlbb_tpu.utils.simulate import topology_record
     from dlbb_tpu.utils.sysinfo import collect_system_info
@@ -123,6 +156,12 @@ def run_serving(
             "serving envelope"
         )
 
+    # chaos-harness activation (mirrors bench/runner.py): explicit arg
+    # wins; else an already-active plan is left alone; else the env
+    fault_spec = fault_plan
+    if fault_spec is None and inject.active() is None:
+        fault_spec = os.environ.get(inject.ENV_VAR, "").strip() or None
+
     name = config.get("experiment", {}).get("name") or (
         f"{trace.kind}_{len(trace)}req_seed{trace.seed}"
     )
@@ -132,17 +171,35 @@ def run_serving(
         jrn = SweepJournal(
             out,
             meta={"mode": "serve", "name": name, "trace_kind": trace.kind,
-                  "num_requests": len(trace)},
+                  "num_requests": len(trace), "fault_plan": fault_spec},
             sink=spans.journal_sink,
         )
+    topology = topology_record()
     try:
-        engine = ServingEngine(
-            model_cfg, serving_cfg, plan.mesh,
-            journal=jrn,
-            seed=config.get("input", {}).get("seed", 0),
-            verbose=verbose,
-        )
-        report = engine.run_trace(trace)
+        with inject.plan_scope(fault_spec), PreemptionGuard() as guard:
+            engine = ServingEngine(
+                model_cfg, serving_cfg, plan.mesh,
+                journal=jrn,
+                seed=config.get("input", {}).get("seed", 0),
+                verbose=verbose,
+            )
+            # degraded-probe fallbacks are first-class events (ROADMAP
+            # standing chore): journaled AND counted, not just a field
+            # buried in the topology record
+            if jrn is not None:
+                jrn.event("topology", **topology)
+            engine.registry.inc(
+                "serve_degraded", 1 if topology["degraded"] else 0,
+                help="runs on a degraded (fallback) backend",
+            )
+            if topology["degraded"]:
+                reason = topology.get("degraded_reason")
+                if jrn is not None:
+                    jrn.event("degraded", reason=reason)
+                if verbose:
+                    print(f"[topology] DEGRADED backend: {reason}")
+            report = engine.run_trace(trace, guard=guard,
+                                      collect_raw=collect_raw)
     finally:
         if jrn is not None:
             jrn.close()
@@ -154,8 +211,29 @@ def run_serving(
     report["timestamp"] = time.time()
 
     if out is not None:
-        result_path = save_json(report, out / f"serving_{name}.json")
         trace_path = trace.save(out / f"trace_{name}.json")
+        if report["preempted"]:
+            # graceful-drain checkpoint: the full replayable trace is
+            # on disk, this records the cursor (remaining rids) + the
+            # partial report with raw samples for the resume merge.
+            # The result artifact is NOT written — an incomplete
+            # session must never masquerade as a run
+            ckpt = {
+                "schema": SERVING_RESUME_SCHEMA,
+                "name": name,
+                "trace_file": trace_path.name,
+                "config": config,
+                "remaining_rids": report["remaining_rids"],
+                "partial": report,
+            }
+            save_json(ckpt, out / RESUME_CHECKPOINT)
+            if verbose:
+                print(f"[serve] preempted — checkpoint written to "
+                      f"{out / RESUME_CHECKPOINT}; finish with "
+                      "`cli serve --resume --output "
+                      f"{out}`")
+            return report
+        result_path = save_json(report, out / f"serving_{name}.json")
         registry = serving_metrics(report, registry=engine.registry)
         prom_path = registry.write_textfile(out / "metrics.prom")
         manifest = {
@@ -170,13 +248,254 @@ def run_serving(
             "compile_time_s": report["compile_time_s"],
             "decode_steps": report["decode_steps"],
             "mesh": report["mesh"],
-            "topology": topology_record(),
+            "topology": topology,
             "journal": (None if jrn is None else jrn.path.name),
         }
         save_json(manifest, out / "serving_manifest.json")
         if verbose:
             print(f"[serve] report written to {result_path}")
     return report
+
+
+def merge_reports(partial: dict[str, Any],
+                  resumed: dict[str, Any]) -> dict[str, Any]:
+    """Merge a preempted session's partial report with its resumed
+    session into one report equivalent (names + schema + per-request
+    outcomes for non-preempted requests) to an uninterrupted run.
+
+    Counters sum across sessions (a preempted-then-replayed request
+    therefore counts in both — ``requests.sessions`` records how many
+    sessions merged); latency summaries are re-summarized over BOTH
+    sessions' raw samples, never faked from two percentile sets; the
+    resumed session's outcome for a rid overrides the partial one (a
+    ``preempted`` marker resolves to its replayed outcome)."""
+    from dlbb_tpu.utils.metrics import summarize
+
+    merged = dict(resumed)
+    merged["trace"] = partial["trace"]  # the FULL trace identity
+    req_a = partial["requests"]
+    req_b = resumed["requests"]
+    req: dict[str, Any] = {
+        k: req_a.get(k, 0) + req_b.get(k, 0)
+        for k in ("arrived", "admitted", "rejected", "completed",
+                  "failed", "preempted", "deadline_shed",
+                  "completed_past_deadline")
+    }
+    req["rejected_detail"] = (list(req_a.get("rejected_detail", []))
+                              + list(req_b.get("rejected_detail", [])))
+    req["rejected_rids"] = [d["rid"] for d in req["rejected_detail"]]
+    outcomes = dict(req_a.get("outcomes", {}))
+    outcomes.update(req_b.get("outcomes", {}))
+    req["outcomes"] = {k: outcomes[k]
+                       for k in sorted(outcomes, key=int)}
+    arrived = req["arrived"]
+    queue_full = sum(1 for d in req["rejected_detail"]
+                     if d.get("reason") == "queue-full")
+    req["shed_rate"] = (queue_full / arrived) if arrived else 0.0
+    req["sessions"] = req_a.get("sessions", 1) + req_b.get("sessions", 1)
+    merged["requests"] = req
+
+    raw: dict[str, list] = {}
+    for key in ("ttft_s", "per_token_s", "prefill_s", "decode_step_s",
+                "e2e_latency_s"):
+        raw[key] = (list(partial.get("raw_samples", {}).get(key, []))
+                    + list(resumed.get("raw_samples", {}).get(key, [])))
+    merged["ttft"] = summarize(raw["ttft_s"])
+    merged["per_token_latency"] = summarize(raw["per_token_s"])
+    merged["e2e_latency"] = summarize(raw["e2e_latency_s"])
+    merged["prefill_time"] = summarize(raw["prefill_s"])
+    merged["decode_step_time"] = summarize(raw["decode_step_s"])
+
+    for key in ("completed_output_tokens", "generated_tokens",
+                "decode_steps", "decode_units", "wall_seconds",
+                "compile_time_s"):
+        merged[key] = partial.get(key, 0) + resumed.get(key, 0)
+    wall = merged["wall_seconds"]
+    merged["goodput_tokens_per_s"] = (
+        merged["completed_output_tokens"] / wall if wall > 0 else 0.0)
+    merged["throughput_tokens_per_s"] = (
+        merged["generated_tokens"] / wall if wall > 0 else 0.0)
+
+    fast = dict(resumed.get("fast_path", {}))
+    for key in ("fused_scans", "fused_steps", "single_steps",
+                "prefill_chunks", "compacted_scans"):
+        fast[key] = (partial.get("fast_path", {}).get(key, 0)
+                     + resumed.get("fast_path", {}).get(key, 0))
+    merged["fast_path"] = fast
+
+    res_a = partial.get("resilience", {})
+    res_b = resumed.get("resilience", {})
+    merged["resilience"] = {
+        "retries": res_a.get("retries", 0) + res_b.get("retries", 0),
+        "hung_dispatches": (res_a.get("hung_dispatches", 0)
+                            + res_b.get("hung_dispatches", 0)),
+        "failed_requests": (res_a.get("failed_requests", 0)
+                            + res_b.get("failed_requests", 0)),
+        "failed": (list(res_a.get("failed", []))
+                   + list(res_b.get("failed", []))),
+    }
+
+    cache = dict(resumed.get("cache", {}))
+    for key in ("peak_blocks_reserved", "peak_blocks_in_use"):
+        cache[key] = max(partial.get("cache", {}).get(key, 0),
+                         resumed.get("cache", {}).get(key, 0))
+    merged["cache"] = cache
+
+    # timeseries: the resumed session re-anchored its clock, so its
+    # samples are offset by the partial session's wall
+    offset = partial.get("wall_seconds", 0.0)
+    series_a = partial.get("timeseries", {})
+    series_b = resumed.get("timeseries", {})
+    series = {}
+    for key in series_a:
+        vals_b = series_b.get(key, [])
+        if key == "t_s":
+            vals_b = [round(t + offset, 6) for t in vals_b]
+        series[key] = list(series_a.get(key, [])) + list(vals_b)
+    merged["timeseries"] = series
+
+    # a resumed session preempted AGAIN keeps its raw samples so the
+    # next resume can merge honestly; a completed merge drops them
+    if resumed.get("preempted"):
+        merged["raw_samples"] = raw
+    else:
+        merged.pop("raw_samples", None)
+    if "completed_tokens" in partial or "completed_tokens" in resumed:
+        toks = dict(partial.get("completed_tokens", {}))
+        toks.update(resumed.get("completed_tokens", {}))
+        merged["completed_tokens"] = toks
+    return merged
+
+
+def resume_serving(
+    output_dir: str,
+    devices: Optional[Sequence] = None,
+    verbose: bool = True,
+) -> dict[str, Any]:
+    """Finish a preempted serving run (``cli serve --resume``).
+
+    Loads ``serving_resume.json`` + the saved full trace, replays the
+    remaining requests (arrivals rebased to the resume instant with
+    their original gaps preserved), merges both sessions, and writes
+    the final artifact set — identical names + schema (and per-request
+    outcomes for non-preempted requests) to an uninterrupted run.  The
+    checkpoint is deleted on success; a session preempted AGAIN
+    rewrites it with the merged partial instead."""
+    from dlbb_tpu.utils.config import save_json
+
+    out = Path(output_dir)
+    ckpt_path = out / RESUME_CHECKPOINT
+    if not ckpt_path.exists():
+        raise FileNotFoundError(
+            f"nothing to resume: no {RESUME_CHECKPOINT} under {out} "
+            "(either the run completed, or it was never preempted)"
+        )
+    ckpt = json.loads(ckpt_path.read_text())
+    if ckpt.get("schema") != SERVING_RESUME_SCHEMA:
+        raise ValueError(
+            f"{ckpt_path} is not a serving resume checkpoint "
+            f"(schema={ckpt.get('schema')!r})"
+        )
+    full = TrafficTrace.load(out / ckpt["trace_file"])
+    remaining = set(ckpt["remaining_rids"])
+    reqs = [r for r in full if r.rid in remaining]
+    if not reqs:
+        raise ValueError(
+            f"checkpoint names no servable remaining requests "
+            f"({len(remaining)} rids, none found in {ckpt['trace_file']})"
+        )
+    # rebase arrivals to the resume instant, preserving the original
+    # inter-arrival gaps so the replayed load keeps its shape
+    t0 = min(r.arrival_s for r in reqs)
+    sub = TrafficTrace(
+        kind=full.kind, seed=full.seed,
+        params={**full.params, "resumed_from": ckpt["name"]},
+        requests=tuple(replace(r, arrival_s=r.arrival_s - t0)
+                       for r in sorted(reqs, key=lambda r: (r.arrival_s,
+                                                            r.rid))),
+    )
+    if verbose:
+        print(f"[serve] resuming {ckpt['name']}: {len(sub)} remaining "
+              f"request(s) of {len(full)}")
+
+    from dlbb_tpu.obs import spans
+    from dlbb_tpu.obs.export import serving_metrics
+    from dlbb_tpu.parallel.plan import ParallelismPlan
+    from dlbb_tpu.resilience.journal import SweepJournal
+    from dlbb_tpu.resilience.preempt import PreemptionGuard
+    from dlbb_tpu.utils.simulate import topology_record
+    from dlbb_tpu.utils.sysinfo import collect_system_info
+
+    config = ckpt["config"]
+    name = ckpt["name"]
+    model_cfg = ModelConfig.from_dict(config.get("model",
+                                                 DEFAULT_SERVE_MODEL))
+    serving_cfg = ServingConfig.from_dict(config.get("serving", {}))
+    plan = ParallelismPlan.from_config(config, model_cfg, devices)
+    # the journal is append-only across sessions: the resume appends a
+    # new session marker + its own lifecycle after the preempted one's
+    jrn = SweepJournal(
+        out,
+        meta={"mode": "serve", "name": name, "resume": True,
+              "remaining": len(sub)},
+        sink=spans.journal_sink,
+    )
+    try:
+        with PreemptionGuard() as guard:
+            engine = ServingEngine(
+                model_cfg, serving_cfg, plan.mesh, journal=jrn,
+                seed=config.get("input", {}).get("seed", 0),
+                verbose=verbose,
+            )
+            resumed = engine.run_trace(sub, guard=guard,
+                                       collect_raw=True)
+    finally:
+        jrn.close()
+    resumed["experiment"] = config.get("experiment", {})
+    resumed["backend"] = "xla_tpu"
+    resumed["mesh"] = plan.mesh_dict()
+    resumed["system_info"] = collect_system_info()
+    resumed["timestamp"] = time.time()
+
+    merged = merge_reports(ckpt["partial"], resumed)
+    if merged.get("preempted"):
+        # preempted AGAIN mid-resume: refresh the checkpoint with the
+        # merged partial; the final artifacts wait for the next resume
+        save_json({
+            "schema": SERVING_RESUME_SCHEMA,
+            "name": name,
+            "trace_file": ckpt["trace_file"],
+            "config": config,
+            "remaining_rids": merged["remaining_rids"],
+            "partial": merged,
+        }, ckpt_path)
+        if verbose:
+            print("[serve] preempted again mid-resume — checkpoint "
+                  "refreshed")
+        return merged
+    result_path = save_json(merged, out / f"serving_{name}.json")
+    registry = serving_metrics(merged, registry=engine.registry)
+    prom_path = registry.write_textfile(out / "metrics.prom")
+    manifest = {
+        "schema": SERVING_MANIFEST_SCHEMA,
+        "name": name,
+        "result": result_path.name,
+        "trace_file": ckpt["trace_file"],
+        "metrics": prom_path.name,
+        "requests": merged["requests"],
+        "goodput_tokens_per_s": merged["goodput_tokens_per_s"],
+        "wall_seconds": merged["wall_seconds"],
+        "compile_time_s": merged["compile_time_s"],
+        "decode_steps": merged["decode_steps"],
+        "mesh": merged["mesh"],
+        "topology": topology_record(),
+        "journal": jrn.path.name,
+    }
+    save_json(manifest, out / "serving_manifest.json")
+    ckpt_path.unlink()
+    if verbose:
+        print(f"[serve] resumed run merged into {result_path}")
+    return merged
 
 
 def run_serve_from_config(
@@ -189,10 +508,17 @@ def run_serve_from_config(
     overrides: Optional[dict[str, Any]] = None,
     devices: Optional[Sequence] = None,
     verbose: bool = True,
+    resume: bool = False,
+    fault_plan: Optional[str] = None,
+    slo: Optional[float] = None,
 ) -> dict[str, Any]:
     """CLI entry: optional experiment YAML + flag overrides (including
     the decode fast-path knobs — decode_horizon / inflight_window /
-    prefill_chunk / compact_threshold, docs/serving.md).
+    prefill_chunk / compact_threshold — and the resilience knobs,
+    docs/serving.md).  ``--resume`` finishes a preempted run from its
+    ``serving_resume.json`` checkpoint; ``--slo SEC`` stamps generated
+    requests with a per-request deadline; ``--fault-plan`` activates
+    the chaos harness.
 
     Without ``--config`` the default small GQA model serves on an
     auto-planned (dp, tp) mesh over the available devices."""
@@ -200,6 +526,9 @@ def run_serve_from_config(
 
     from dlbb_tpu.utils.config import load_config
 
+    if resume:
+        out = output_dir or "results/serving"
+        return resume_serving(out, devices=devices, verbose=verbose)
     if config_path is not None:
         config = load_config(config_path)
     else:
@@ -218,8 +547,9 @@ def run_serve_from_config(
                                      serving_cfg.max_batch)
         config["parallelism"] = {"data_parallel": dp, "world_size": tp}
     resolved = resolve_trace(trace, num_requests=num_requests, seed=seed,
-                             rate=rate, serving=serving_cfg)
+                             rate=rate, serving=serving_cfg,
+                             deadline_s=slo)
     out = output_dir or config.get("experiment", {}).get(
         "output_dir", "results/serving")
     return run_serving(config, resolved, output_dir=out, devices=devices,
-                       verbose=verbose)
+                       verbose=verbose, fault_plan=fault_plan)

@@ -67,11 +67,32 @@ engine's legacy per-step behaviour is bit-for-bit preserved):
   gather-repacked into a half-size decode batch bucket for the fused
   scan and scattered back at scan exit — priced as a measured variant
   (``scripts/bench_serving.py``), never assumed to win.
+
+Resilience (``docs/resilience.md``, serving faults): every fault site
+fires strictly on the HOST side of a dispatch boundary — the jitted
+programs above are byte-identical with or without an active plan
+(statically pinned).  A transiently-failed prefill/decode dispatch
+rolls the host ledger/slot bookkeeping back to a pre-dispatch snapshot
+and re-issues with exponential backoff; exhausted retries fail only
+the affected requests, journaled ``request-failed`` with full
+exception chains — never the run.  ``dispatch_deadline_factor`` arms
+an EMA-scaled watchdog (the PR-5 daemon-thread pattern) that abandons
+a hung dispatch or window sync and continues on a fresh carry.
+Requests may carry per-arrival SLO deadlines (blown queue heads shed
+as ``request-rejected[reason=deadline]``, late completions counted).
+SIGTERM under the run's ``PreemptionGuard`` drains gracefully:
+admission stops, the in-flight window settles, resident requests are
+journaled ``request-preempted``, and the report carries the
+remaining-rid cursor ``serve/bench.py`` checkpoints for
+``cli serve --resume``.
 """
 
 from __future__ import annotations
 
 import math
+import os
+import signal
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -92,6 +113,16 @@ from dlbb_tpu.models.transformer import (
 )
 from dlbb_tpu.obs import spans
 from dlbb_tpu.obs.export import MetricsRegistry
+from dlbb_tpu.resilience import inject
+from dlbb_tpu.resilience.errors import (
+    CorruptStats,
+    DeadlineExceeded,
+    InjectedFault,
+    TransientFault,
+    exception_chain,
+    is_transient,
+)
+from dlbb_tpu.resilience.preempt import PreemptionGuard
 from dlbb_tpu.serve.kvcache import (
     BlockLedger,
     KVCache,
@@ -157,6 +188,25 @@ class ServingConfig:
     reject_infeasible: reject-and-journal requests the envelope cannot
                      serve (reason="infeasible") instead of failing the
                      whole trace up front (the strict default).
+    max_dispatch_retries: bounded retries (exponential backoff) for a
+                     transiently-failed prefill/decode dispatch; each
+                     retry rolls the host ledger/slot state back to the
+                     pre-dispatch snapshot first.  Exhaustion fails only
+                     the affected requests (journaled ``request-failed``
+                     with the exception chain), never the run.
+    retry_backoff_s: base backoff delay; attempt N sleeps
+                     ``retry_backoff_s * 2**(N-1)``.
+    dispatch_deadline_factor: arms the in-flight dispatch watchdog: a
+                     decode unit (or its sync) exceeding
+                     ``max(dispatch_deadline_min_s, factor * k *
+                     per-step-EMA)`` wall seconds is abandoned on its
+                     daemon thread (the PR-5 pattern), its slots'
+                     requests journaled ``request-failed[reason=
+                     hung-dispatch]`` and freed, and the engine
+                     continues on a fresh carry.  None (default)
+                     disables — zero threads, zero overhead.
+    dispatch_deadline_min_s: watchdog floor while the per-step EMA is
+                     still cold (and for tiny EMAs).
     """
 
     max_batch: int = 8
@@ -171,6 +221,10 @@ class ServingConfig:
     prefill_chunk: Optional[int] = None
     compact_threshold: Optional[float] = None
     reject_infeasible: bool = False
+    max_dispatch_retries: int = 2
+    retry_backoff_s: float = 0.05
+    dispatch_deadline_factor: Optional[float] = None
+    dispatch_deadline_min_s: float = 0.25
 
     def __post_init__(self) -> None:
         if not self.prefill_buckets:
@@ -280,6 +334,27 @@ class ServingConfig:
                     "gather/scatter must stay shard-local, and the slot "
                     f"dim is sharded over dp={dp}"
                 )
+        if self.max_dispatch_retries < 0:
+            raise ValueError(
+                f"serving.max_dispatch_retries must be >= 0, got "
+                f"{self.max_dispatch_retries}"
+            )
+        if self.retry_backoff_s < 0:
+            raise ValueError(
+                f"serving.retry_backoff_s must be >= 0, got "
+                f"{self.retry_backoff_s}"
+            )
+        if (self.dispatch_deadline_factor is not None
+                and self.dispatch_deadline_factor <= 0):
+            raise ValueError(
+                f"serving.dispatch_deadline_factor must be > 0, got "
+                f"{self.dispatch_deadline_factor}"
+            )
+        if self.dispatch_deadline_min_s <= 0:
+            raise ValueError(
+                f"serving.dispatch_deadline_min_s must be > 0 seconds, "
+                f"got {self.dispatch_deadline_min_s}"
+            )
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.prefill_buckets:
@@ -296,7 +371,9 @@ class ServingConfig:
         for k in ("max_batch", "block_size", "max_seq", "queue_capacity",
                   "blocks_budget", "hbm_budget_gb", "decode_horizon",
                   "inflight_window", "prefill_chunk", "compact_threshold",
-                  "reject_infeasible"):
+                  "reject_infeasible", "max_dispatch_retries",
+                  "retry_backoff_s", "dispatch_deadline_factor",
+                  "dispatch_deadline_min_s"):
             if k in d:
                 fields[k] = d[k]
         if "prefill_buckets" in d:
@@ -318,6 +395,10 @@ class ServingConfig:
             "prefill_chunk": self.prefill_chunk,
             "compact_threshold": self.compact_threshold,
             "reject_infeasible": self.reject_infeasible,
+            "max_dispatch_retries": self.max_dispatch_retries,
+            "retry_backoff_s": self.retry_backoff_s,
+            "dispatch_deadline_factor": self.dispatch_deadline_factor,
+            "dispatch_deadline_min_s": self.dispatch_deadline_min_s,
         }
 
     @property
@@ -772,6 +853,39 @@ def _inject_token(carry, slot, vec):
     return cache, jnp.where(mask, vec[None, None, :].astype(x.dtype), x)
 
 
+def _with_deadline(fn, deadline: Optional[float], label: str,
+                   phase: str) -> Any:
+    """Run ``fn()`` under the serving dispatch watchdog (the PR-5
+    daemon-thread pattern, ``bench/runner._call_with_deadline``).
+
+    With no deadline this is a direct call — zero threads, zero
+    overhead.  With one, ``fn`` runs on a daemon thread joined for
+    ``deadline`` seconds; an overrun ABANDONS the thread (it may be
+    wedged inside the runtime and cannot be killed) and raises
+    :class:`DeadlineExceeded` — the engine then fails the unit's
+    requests closed and continues on a fresh carry, so the zombie's
+    eventual outputs (if any) are never consumed."""
+    if deadline is None:
+        return fn()
+    box: dict[str, Any] = {}
+
+    def target() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — marshalled to caller
+            box["error"] = e
+
+    t = threading.Thread(target=target, daemon=True,
+                         name=f"dlbb-serve-{phase}-{label}")
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        raise DeadlineExceeded(label, deadline, phase=phase)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
 # ---------------------------------------------------------------------------
 # the engine
 # ---------------------------------------------------------------------------
@@ -801,6 +915,13 @@ class _RunStats:
     single_steps: int = 0
     prefill_chunks: int = 0
     compacted_scans: int = 0
+    # resilience accounting (docs/resilience.md, serving-faults section)
+    retries: int = 0
+    hung_dispatches: int = 0
+    failed_requests: int = 0
+    preempted_requests: int = 0
+    deadline_shed: int = 0
+    completed_past_deadline: int = 0
 
 
 class ServingEngine:
@@ -840,13 +961,24 @@ class ServingEngine:
         self.registry = registry if registry is not None else MetricsRegistry()
         self._requests = self.registry.labeled_counter(
             "serve_requests", "outcome",
-            initial=("arrived", "admitted", "rejected", "completed"),
+            initial=("arrived", "admitted", "rejected", "completed",
+                     "failed", "preempted"),
             help="request lifecycle outcomes",
         )
         self._rejections = self.registry.labeled_counter(
             "serve_rejections", "reason",
-            initial=("queue-full", "infeasible"),
+            initial=("queue-full", "infeasible", "deadline"),
             help="requests shed, by rejection reason",
+        )
+        self._retry_counter = self.registry.labeled_counter(
+            "serve_request_retries", "phase",
+            initial=("prefill", "decode", "bookkeeping"),
+            help="transient dispatch/bookkeeping retries, by phase",
+        )
+        self._deadline_counter = self.registry.labeled_counter(
+            "serve_deadline_exceeded", "reason",
+            initial=("shed-queued", "completed-late"),
+            help="per-request SLO deadline misses, by how they surfaced",
         )
         for name, hlp in (
             ("serve_decode_steps",
@@ -854,6 +986,8 @@ class ServingEngine:
             ("serve_fused_scan_steps",
              "decode steps executed inside fused lax.scan dispatches"),
             ("serve_prefill_chunks", "prefill chunks processed"),
+            ("serve_hung_dispatches",
+             "decode units abandoned by the dispatch watchdog"),
         ):
             self.registry.inc(name, 0, help=hlp)
         self._dtype = _dtype_of(config.dtype)
@@ -999,10 +1133,30 @@ class ServingEngine:
 
     # -- the run -----------------------------------------------------------
 
-    def run_trace(self, trace: TrafficTrace) -> dict[str, Any]:
-        """Serve ``trace`` to completion; returns the report dict
-        (``docs/serving.md`` documents every field).  Pure compute + host
-        scheduling — writing artifacts is ``serve/bench.py``'s job."""
+    def run_trace(self, trace: TrafficTrace,
+                  guard: Optional[PreemptionGuard] = None,
+                  collect_raw: bool = False) -> dict[str, Any]:
+        """Serve ``trace`` to completion (or to a graceful preemption
+        drain); returns the report dict (``docs/serving.md`` documents
+        every field).  Pure compute + host scheduling — writing
+        artifacts is ``serve/bench.py``'s job.
+
+        ``guard``: an installed :class:`PreemptionGuard` (the bench
+        harness passes its own); None installs one for the run when
+        possible (main thread).  On SIGTERM the engine stops admission,
+        drains the in-flight window, journals still-resident requests
+        ``request-preempted``, and returns a report with
+        ``preempted=True`` + ``remaining_rids`` — the snapshot
+        ``cli serve --resume`` replays.  ``collect_raw`` adds the raw
+        latency sample lists to the report (``raw_samples``; always
+        present on a preempted report so resume can merge honestly)."""
+        if guard is None:
+            with PreemptionGuard() as own:
+                return self._serve_trace(trace, own, collect_raw)
+        return self._serve_trace(trace, guard, collect_raw)
+
+    def _serve_trace(self, trace: TrafficTrace, guard: PreemptionGuard,
+                     collect_raw: bool) -> dict[str, Any]:
         if not len(trace):
             raise ValueError("cannot serve an empty trace")
         cfg = self.serving
@@ -1049,6 +1203,13 @@ class ServingEngine:
                                     self._active_sharding)
         rejected_detail: list[dict[str, Any]] = []
         tokens_by_rid: dict[int, list[int]] = {}
+        # per-request final outcome map (rid -> "completed" /
+        # "rejected[reason]" / "failed[reason]" / "preempted") — the
+        # thing kill-mid-trace ≡ uninterrupted equivalence is pinned on
+        outcomes: dict[int, str] = {}
+        # permanent-failure records: full exception chains, never a
+        # silent skip (the serving twin of the sweep quarantine)
+        failed_detail: list[dict[str, Any]] = []
         # bounded in-flight window: decode units dispatched but not yet
         # synced (cfg.inflight_window == 1 syncs every unit — the
         # legacy cadence); last_sync anchors the per-unit interval so
@@ -1083,20 +1244,120 @@ class ServingEngine:
         def finish(st: _SlotState, done_at: float) -> None:
             """Completion stats + journal at the unit's SYNC point (the
             honest timestamp — the device work is provably done)."""
-            stats.e2e_latency_s.append(done_at - st.req.arrival_s)
+            lat = done_at - st.req.arrival_s
+            stats.e2e_latency_s.append(lat)
             stats.completed_output_tokens += st.req.output_len
             self._requests["completed"] += 1
+            outcomes[st.req.rid] = "completed"
+            extra: dict[str, Any] = {}
+            if st.req.deadline_s is not None and lat > st.req.deadline_s:
+                # served, but past its SLO — a first-class count, not a
+                # rejection (the tokens were delivered)
+                stats.completed_past_deadline += 1
+                self._deadline_counter["completed-late"] += 1
+                extra["past_deadline"] = True
             self._event("request-completed", st.req.rid,
                         output_tokens=st.req.output_len,
-                        latency_s=round(done_at - st.req.arrival_s, 6))
+                        latency_s=round(lat, 6), **extra)
+
+        def take_snapshot() -> dict[str, Any]:
+            """Pre-dispatch rollback point: the host ledger/slot/
+            admission bookkeeping (tiny, host-only copies).  The device
+            carry needs no snapshot because every fault site fires
+            BEFORE the jit consumes it — a restored host state always
+            matches the on-device state (docs/resilience.md)."""
+            return {
+                "ledger": ledger.snapshot(),
+                "slots": {s: (st, st.tokens_done)
+                          for s, st in slots.items()},
+                "free_slots": list(free_slots),
+                "active": active_np.copy(),
+                "generated": stats.generated_tokens,
+            }
+
+        def restore_snapshot(snap: dict[str, Any]) -> None:
+            ledger.restore(snap["ledger"])
+            slots.clear()
+            for s, (st, td) in snap["slots"].items():
+                st.tokens_done = td
+                slots[s] = st
+            free_slots[:] = snap["free_slots"]
+            active_np[:] = snap["active"]
+            active_dirty[0] = True
+            stats.generated_tokens = snap["generated"]
+
+        def fail_requests(states: list[_SlotState], exc: BaseException,
+                          reason: str) -> None:
+            """Fail requests CLOSED: journaled ``request-failed`` with
+            the full exception chain, outcome recorded, counters bumped
+            — never a silent skip, and never the whole run."""
+            rec = exception_chain(exc)
+            rids = []
+            for st in states:
+                rids.append(st.req.rid)
+                outcomes[st.req.rid] = f"failed[{reason}]"
+                stats.failed_requests += 1
+                self._requests["failed"] += 1
+                self._event("request-failed", st.req.rid, reason=reason,
+                            error=rec["error"],
+                            tokens_done=st.tokens_done)
+            failed_detail.append({"reason": reason, "rids": rids, **rec})
+
+        def fail_resident(exc: BaseException, reason: str) -> None:
+            """Fail every currently-resident request (the affected set
+            of a permanently-failed or hung decode unit — decode covers
+            the whole resident batch), freeing their slots + blocks."""
+            fail_requests([release(s) for s in sorted(list(slots))],
+                          exc, reason)
 
         # EMA of the observed per-step interval: the horizon policy uses
-        # it to convert "next arrival in X seconds" into a step budget
+        # it to convert "next arrival in X seconds" into a step budget,
+        # and the dispatch watchdog scales its deadline from it
         step_ema = [0.0]
+        # bumped at every catastrophic carry replacement (hung/failed
+        # dispatch, abandoned window): the chunked-prefill interleave
+        # checks it — chunks already written to the OLD cache are gone
+        # with it, so a mid-prefill reset must restart the prefill
+        # rather than keep chunking into the fresh empty cache
+        carry_resets = [0]
+
+        def unit_deadline(k: int) -> Optional[float]:
+            """Watchdog deadline for a k-step unit: EMA-scaled with a
+            floor while the EMA is cold; None = watchdog off."""
+            f = cfg.dispatch_deadline_factor
+            if f is None:
+                return None
+            return max(cfg.dispatch_deadline_min_s, f * k * step_ema[0])
+
+        def abandon_window(first_unit: dict[str, Any],
+                           exc: BaseException) -> None:
+            """A unit's sync blew its deadline: every un-synced unit
+            chains off the same donated carry, so the whole window is
+            abandoned — its requests (including completions that were
+            never confirmed at a sync point) fail closed, and the
+            engine continues on a fresh carry."""
+            nonlocal carry
+            stats.hung_dispatches += 1
+            self.registry.inc("serve_hung_dispatches")
+            hung = [first_unit] + list(inflight)
+            inflight.clear()
+            last_sync[0] = time.perf_counter()
+            unconfirmed = [st for u in hung for st in u["completions"]]
+            fail_requests(unconfirmed, exc, "hung-dispatch")
+            fail_resident(exc, "hung-dispatch")
+            carry = self._fresh_carry()
+            carry_resets[0] += 1
 
         def sync_one() -> None:
             unit = inflight.popleft()
-            jax.block_until_ready(unit["ys"])
+            try:
+                _with_deadline(
+                    lambda: jax.block_until_ready(unit["ys"]),
+                    unit_deadline(unit["k_exec"]),
+                    f"decode[k={unit['k_exec']}]", "serve-sync")
+            except DeadlineExceeded as e:
+                abandon_window(unit, e)
+                return
             t_ready = time.perf_counter()
             dt = t_ready - max(unit["t0"], last_sync[0])
             last_sync[0] = t_ready
@@ -1123,6 +1384,161 @@ class ServingEngine:
             while inflight:
                 sync_one()
 
+        def decode_unit(k: int, steps: dict[int, int], compact: bool,
+                        snap: dict[str, Any]) -> None:
+            """One decode unit, committed: the device dispatch (under
+            the watchdog when armed), torn-protected host bookkeeping,
+            and the in-flight window push + boundary sync.  Transient
+            bookkeeping faults roll themselves back and replay (pure
+            host recomputation — the device result is already in hand,
+            so NEVER a re-dispatch); everything else raises out to
+            ``dispatch_decode``'s recovery loop with nothing committed."""
+            nonlocal carry
+            rows: list[tuple[int, int, int, int]] = []
+            deadline = unit_deadline(k)
+            t0 = time.perf_counter()
+            # ONE span per dispatched unit, covering dispatch AND the
+            # boundary sync below — in the per-step/window=1 cadence
+            # the span therefore spans the real step wall (as PR-9's
+            # did); under a deeper window the synced device time
+            # belongs to an older unit and per-unit device attribution
+            # lives in decode_step_s/per_token_s instead
+            span_args = dict(active=len(slots), steps=k)
+            if compact:
+                span_args["compacted"] = True
+            with spans.span("serve-decode", **span_args):
+                if inject.fire("serve-decode-fail"):
+                    # fires BEFORE the jit is invoked: the donated carry
+                    # was never consumed, so a retry re-dispatches from
+                    # unchanged device state
+                    raise TransientFault(
+                        "injected serve-decode-fail at the decode "
+                        "dispatch boundary")
+
+                def dispatch(fn):
+                    def run():
+                        if inject.fire("serve-decode-hang"):
+                            # a wedged dispatch: the sleep sits on the
+                            # watchdog's daemon thread, never on the
+                            # engine's scheduler thread
+                            time.sleep(inject.param("hang_seconds"))
+                        return fn()
+                    return _with_deadline(run, deadline,
+                                          f"decode[k={k}]",
+                                          "serve-dispatch")
+
+                if k == 1:
+                    carry, ys = dispatch(
+                        lambda: self._decode(carry, self.params,
+                                             active_dev))
+                    stats.single_steps += 1
+                    for s in sorted(steps):
+                        rows.append((s, s, slots[s].req.rid, 1))
+                elif compact:
+                    bucket = cfg.max_batch // 2
+                    act = sorted(slots)
+                    idx_np = np.asarray(
+                        act + free_slots[:bucket - len(act)], np.int32)
+                    idx = jax.device_put(jnp.asarray(idx_np),
+                                         self._active_sharding)
+                    s_act_np = np.zeros((bucket,), bool)
+                    s_act_np[:len(act)] = True
+                    s_rem_np = np.zeros((bucket,), np.int32)
+                    for i, s in enumerate(act):
+                        s_rem_np[i] = steps[s]
+                    s_act = jax.device_put(jnp.asarray(s_act_np),
+                                           self._active_sharding)
+                    s_rem = jax.device_put(jnp.asarray(s_rem_np),
+                                           self._active_sharding)
+
+                    def compact_unit():
+                        small = self._compact_gather_fn(carry, idx)
+                        small, ys = self._decode_fused[k](
+                            small, self.params, s_act, s_rem)
+                        return (self._compact_scatter_fn(carry, small,
+                                                         idx), ys)
+
+                    carry, ys = dispatch(compact_unit)
+                    stats.fused_scans += 1
+                    stats.fused_steps += k
+                    stats.compacted_scans += 1
+                    self.registry.inc("serve_fused_scan_steps", k)
+                    for i, s in enumerate(act):
+                        rows.append((i, s, slots[s].req.rid, steps[s]))
+                else:
+                    rem_np = np.zeros((cfg.max_batch,), np.int32)
+                    for s, m in steps.items():
+                        rem_np[s] = m
+                    rem_dev = jax.device_put(jnp.asarray(rem_np),
+                                             self._active_sharding)
+                    carry, ys = dispatch(
+                        lambda: self._decode_fused[k](
+                            carry, self.params, active_dev, rem_dev))
+                    stats.fused_scans += 1
+                    stats.fused_steps += k
+                    self.registry.inc("serve_fused_scan_steps", k)
+                    for s in sorted(steps):
+                        rows.append((s, s, slots[s].req.rid, steps[s]))
+                # host bookkeeping at scan exit: the ledger's known
+                # lengths make every step's outcome deterministic at
+                # dispatch time.  A torn half-applied update
+                # (serve-cache-torn) restores the pre-dispatch snapshot
+                # and REPLAYS the accounting — the device result is
+                # already in hand, so this is pure host recomputation,
+                # never a re-dispatch
+                book_attempt = 0
+                while True:
+                    completions: list[int] = []
+                    try:
+                        for s, m in sorted(steps.items()):
+                            st = slots[s]
+                            st.tokens_done += m
+                            if inject.fire("serve-cache-torn"):
+                                raise TransientFault(
+                                    "injected serve-cache-torn: ledger/"
+                                    "slot bookkeeping torn mid-unit")
+                            ledger.append(s, m)
+                            stats.generated_tokens += m
+                            if st.tokens_done >= st.req.output_len:
+                                completions.append(s)
+                        break
+                    except (TransientFault, CorruptStats) as e:
+                        restore_snapshot(snap)
+                        if book_attempt >= cfg.max_dispatch_retries:
+                            raise RuntimeError(
+                                "ledger/slot bookkeeping kept failing "
+                                "after the decode unit completed on "
+                                "device"
+                            ) from e
+                        book_attempt += 1
+                        stats.retries += 1
+                        self._retry_counter["bookkeeping"] += 1
+                        if self.journal is not None:
+                            self.journal.event(
+                                "dispatch-retry", phase="bookkeeping",
+                                attempt=book_attempt, error=str(e))
+                        time.sleep(cfg.retry_backoff_s
+                                   * (2 ** (book_attempt - 1)))
+                stats.decode_steps += k
+                stats.decode_units += 1
+                self.registry.inc("serve_decode_steps", k)
+                done_states = [release(s) for s in completions]
+                if completions:
+                    refresh_active()
+                inflight.append({"t0": t0, "ys": ys, "k_exec": k,
+                                 "rows": rows,
+                                 "completions": done_states})
+                # a k==1 unit's y is the SAME logical value as the
+                # carry's x (decode_step returns ((cache, y), y)); on
+                # donation-honoring backends the duplicate outputs may
+                # alias one buffer, and the next dispatch donating the
+                # carry would invalidate the held ys — so per-step
+                # units never stay in flight (a fused scan's stacked
+                # ys is its own buffer and may)
+                window = 1 if k == 1 else cfg.inflight_window
+                while len(inflight) >= window:
+                    sync_one()
+
         def dispatch_decode(max_k: Optional[int] = None) -> None:
             """One decode unit over the resident batch: a single step,
             or — when no scheduling event needs an earlier boundary — a
@@ -1131,7 +1547,17 @@ class ServingEngine:
             ``max_k`` caps the horizon (the chunked-prefill interleave
             passes 1: the mid-admission request is itself a waiter, and
             a full fused scan between chunks would re-create the
-            head-of-line blocking the interleave exists to remove)."""
+            head-of-line blocking the interleave exists to remove).
+
+            Hardened (docs/resilience.md, serving faults): a
+            transiently-failed dispatch rolls the host ledger/slot
+            state back to the pre-dispatch snapshot and re-issues with
+            exponential backoff; exhaustion — or a real dispatch error
+            — fails only the resident requests (full exception chains,
+            journaled ``request-failed``), never the run; a dispatch
+            exceeding the EMA-scaled watchdog deadline is abandoned on
+            its daemon thread and the engine continues on a fresh
+            carry."""
             nonlocal carry
             refresh_active()
             rem = {s: slots[s].req.output_len - slots[s].tokens_done
@@ -1168,97 +1594,203 @@ class ServingEngine:
                 and len(slots) <= cfg.compact_threshold * cfg.max_batch
                 and len(slots) <= cfg.max_batch // 2
             )
-            rows: list[tuple[int, int, int, int]] = []
-            t0 = time.perf_counter()
-            # ONE span per dispatched unit, covering dispatch AND the
-            # boundary sync below — in the per-step/window=1 cadence
-            # the span therefore spans the real step wall (as PR-9's
-            # did); under a deeper window the synced device time
-            # belongs to an older unit and per-unit device attribution
-            # lives in decode_step_s/per_token_s instead
-            span_args = dict(active=len(slots), steps=k)
-            if compact:
-                span_args["compacted"] = True
-            with spans.span("serve-decode", **span_args):
-                if k == 1:
-                    carry, ys = self._decode(carry, self.params,
-                                             active_dev)
-                    stats.single_steps += 1
-                    for s in sorted(steps):
-                        rows.append((s, s, slots[s].req.rid, 1))
-                elif compact:
-                    bucket = cfg.max_batch // 2
-                    act = sorted(slots)
-                    idx_np = np.asarray(
-                        act + free_slots[:bucket - len(act)], np.int32)
-                    idx = jax.device_put(jnp.asarray(idx_np),
-                                         self._active_sharding)
-                    s_act_np = np.zeros((bucket,), bool)
-                    s_act_np[:len(act)] = True
-                    s_rem_np = np.zeros((bucket,), np.int32)
-                    for i, s in enumerate(act):
-                        s_rem_np[i] = steps[s]
-                    s_act = jax.device_put(jnp.asarray(s_act_np),
-                                           self._active_sharding)
-                    s_rem = jax.device_put(jnp.asarray(s_rem_np),
-                                           self._active_sharding)
-                    small = self._compact_gather_fn(carry, idx)
-                    small, ys = self._decode_fused[k](
-                        small, self.params, s_act, s_rem)
-                    carry = self._compact_scatter_fn(carry, small, idx)
-                    stats.fused_scans += 1
-                    stats.fused_steps += k
-                    stats.compacted_scans += 1
-                    self.registry.inc("serve_fused_scan_steps", k)
-                    for i, s in enumerate(act):
-                        rows.append((i, s, slots[s].req.rid, steps[s]))
-                else:
-                    rem_np = np.zeros((cfg.max_batch,), np.int32)
-                    for s, m in steps.items():
-                        rem_np[s] = m
-                    rem_dev = jax.device_put(jnp.asarray(rem_np),
-                                             self._active_sharding)
-                    carry, ys = self._decode_fused[k](
-                        carry, self.params, active_dev, rem_dev)
-                    stats.fused_scans += 1
-                    stats.fused_steps += k
-                    self.registry.inc("serve_fused_scan_steps", k)
-                    for s in sorted(steps):
-                        rows.append((s, s, slots[s].req.rid, steps[s]))
-                # host bookkeeping at scan exit: the ledger's known
-                # lengths make every step's outcome deterministic at
-                # dispatch time
-                completions = []
-                for s, m in sorted(steps.items()):
-                    st = slots[s]
-                    st.tokens_done += m
-                    ledger.append(s, m)
-                    stats.generated_tokens += m
-                    if st.tokens_done >= st.req.output_len:
-                        completions.append(s)
-                stats.decode_steps += k
-                stats.decode_units += 1
-                self.registry.inc("serve_decode_steps", k)
-                done_states = [release(s) for s in completions]
-                if completions:
-                    refresh_active()
-                inflight.append({"t0": t0, "ys": ys, "k_exec": k,
-                                 "rows": rows,
-                                 "completions": done_states})
-                # a k==1 unit's y is the SAME logical value as the
-                # carry's x (decode_step returns ((cache, y), y)); on
-                # donation-honoring backends the duplicate outputs may
-                # alias one buffer, and the next dispatch donating the
-                # carry would invalidate the held ys — so per-step
-                # units never stay in flight (a fused scan's stacked
-                # ys is its own buffer and may)
-                window = 1 if k == 1 else cfg.inflight_window
-                while len(inflight) >= window:
-                    sync_one()
+            snap = take_snapshot()
+            attempt = 0
+            while True:
+                try:
+                    decode_unit(k, steps, compact, snap)
+                    return
+                except (TransientFault, CorruptStats) as e:
+                    # fired BEFORE the jit consumed the carry (the
+                    # injection contract): restore the host snapshot
+                    # and re-issue the same unit
+                    restore_snapshot(snap)
+                    if attempt >= cfg.max_dispatch_retries:
+                        fail_resident(e, "dispatch-failed")
+                        return
+                    attempt += 1
+                    stats.retries += 1
+                    self._retry_counter["decode"] += 1
+                    if self.journal is not None:
+                        self.journal.event("dispatch-retry",
+                                           phase="decode",
+                                           attempt=attempt,
+                                           error=str(e))
+                    time.sleep(cfg.retry_backoff_s * (2 ** (attempt - 1)))
+                except DeadlineExceeded as e:
+                    # hung dispatch: the zombie daemon thread still
+                    # holds the donated carry — settle the valid
+                    # in-flight tail, fail the resident batch closed,
+                    # continue on a fresh carry
+                    restore_snapshot(snap)
+                    stats.hung_dispatches += 1
+                    self.registry.inc("serve_hung_dispatches")
+                    drain()
+                    fail_resident(e, "hung-dispatch")
+                    carry = self._fresh_carry()
+                    carry_resets[0] += 1
+                    return
+                except Exception as e:  # noqa: BLE001 — fail closed
+                    # a real (non-injected) dispatch failure: the
+                    # donated carry must be presumed consumed — fail
+                    # the resident batch closed with the exception
+                    # chain and continue on a fresh carry
+                    restore_snapshot(snap)
+                    try:
+                        drain()
+                    except Exception:  # noqa: BLE001
+                        inflight.clear()
+                    fail_resident(e, "dispatch-failed")
+                    carry = self._fresh_carry()
+                    carry_resets[0] += 1
+                    return
+
+        def prefill_once(req: Request, slot: int):
+            """The prefill dispatch for one admitted request (chunked or
+            monolithic) — returns ``(bucket, y_last, dt)``.  Raised
+            through by the retry wrapper below; idempotent on retry:
+            chunk writes are deterministic masked selects of identical
+            values, and interleaved decode units commit independently."""
+            nonlocal carry
+            if inject.fire("serve-prefill-fail"):
+                # fires BEFORE any jit is invoked — see serve-decode-fail
+                raise TransientFault(
+                    "injected serve-prefill-fail at the prefill "
+                    "dispatch boundary")
+            if cfg.prefill_chunk is not None:
+                chunk = cfg.prefill_chunk
+                n_chunks = -(-req.prompt_len // chunk)
+                bucket = n_chunks * chunk
+                x_prompt = request_embeddings(
+                    req.seed, req.prompt_len,
+                    self.config.hidden_size,
+                    dtype=self._dtype, pad_to=bucket,
+                )
+                with spans.span("serve-prefill", rid=req.rid,
+                                bucket=bucket, slot=slot,
+                                chunks=n_chunks):
+                    t0 = time.perf_counter()
+                    decode_spent = 0.0
+                    prefix = create_prefix(self.config, self.mesh)
+                    cache = carry[0]
+                    for ci in range(n_chunks):
+                        with spans.span("serve-prefill-chunk",
+                                        rid=req.rid, chunk=ci):
+                            cache, prefix, y_last = \
+                                self._chunk_jit(ci)(
+                                    cache, prefix,
+                                    self.params,
+                                    x_prompt[:, ci * chunk:
+                                             (ci + 1) * chunk],
+                                    np.int32(slot),
+                                    np.int32(req.prompt_len))
+                        stats.prefill_chunks += 1
+                        self.registry.inc("serve_prefill_chunks")
+                        if ci < n_chunks - 1 and slots:
+                            # interleave: the resident batch decodes
+                            # between chunks instead of head-of-line
+                            # blocking
+                            carry = (cache, carry[1])
+                            td = time.perf_counter()
+                            resets = carry_resets[0]
+                            dispatch_decode(max_k=1)
+                            decode_spent += time.perf_counter() - td
+                            if carry_resets[0] != resets:
+                                # the resident batch failed and took the
+                                # carry with it — this request's chunks
+                                # 0..ci died in the old cache; restart
+                                # the prefill on the fresh carry (chunk
+                                # writes are deterministic, so a replay
+                                # is exact) via the retry wrapper
+                                raise TransientFault(
+                                    "carry reset during the chunked-"
+                                    "prefill interleave (resident batch "
+                                    "failed closed)")
+                            cache = carry[0]
+                    carry = (cache, carry[1])
+                    jax.block_until_ready(y_last)
+                    # the interleaved units' dispatch+sync time is
+                    # already billed to decode_step_s/per_token_s —
+                    # keep prefill_s a PREFILL cost
+                    dt = time.perf_counter() - t0 - decode_spent
+            else:
+                bucket = cfg.bucket_for(req.prompt_len)
+                x_prompt = request_embeddings(
+                    req.seed, req.prompt_len,
+                    self.config.hidden_size,
+                    dtype=self._dtype, pad_to=bucket,
+                )
+                with spans.span("serve-prefill", rid=req.rid,
+                                bucket=bucket, slot=slot):
+                    t0 = time.perf_counter()
+                    cache, y_last = self._prefill(
+                        carry[0], self.params, x_prompt,
+                        np.int32(slot), np.int32(req.prompt_len))
+                    jax.block_until_ready(y_last)
+                    dt = time.perf_counter() - t0
+                carry = (cache, carry[1])
+            return bucket, y_last, dt
+
+        def prefill_dispatch(req: Request, slot: int):
+            """Bounded-retry wrapper around :func:`prefill_once` —
+            transient dispatch failures back off and re-issue (chunk
+            counters rolled back so a retried prefill never
+            double-counts); exhaustion raises to the admission loop's
+            fail-closed path."""
+            attempt = 0
+            while True:
+                chunks_base = stats.prefill_chunks
+                try:
+                    return prefill_once(req, slot)
+                except (TransientFault, CorruptStats) as e:
+                    stats.prefill_chunks = chunks_base
+                    if attempt >= cfg.max_dispatch_retries:
+                        raise
+                    attempt += 1
+                    stats.retries += 1
+                    self._retry_counter["prefill"] += 1
+                    if self.journal is not None:
+                        self.journal.event("dispatch-retry",
+                                           phase="prefill", rid=req.rid,
+                                           attempt=attempt,
+                                           error=str(e))
+                    time.sleep(cfg.retry_backoff_s * (2 ** (attempt - 1)))
+
+        def fail_admission(req: Request, slot: int,
+                           exc: BaseException) -> None:
+            """A permanently-failed prefill fails ONLY the admitting
+            request: reservation undone, journaled with the chain.  A
+            real (non-injected) failure also consumed the donated
+            cache, so the resident batch fails closed too and the
+            engine continues on a fresh carry."""
+            nonlocal carry
+            ledger.free(slot)
+            free_slots.append(slot)
+            free_slots.sort()
+            fail_requests([_SlotState(req=req, tokens_done=0)], exc,
+                          "dispatch-failed")
+            if not isinstance(exc, InjectedFault):
+                fail_resident(exc, "dispatch-failed")
+                carry = self._fresh_carry()
 
         self._t0 = time.perf_counter()
         last_sync[0] = self._t0
+        preempted = False
         while pending or queue or slots:
+            if inject.fire("serve-preempt"):
+                # chaos harness: deliver a real SIGTERM to ourselves —
+                # the PreemptionGuard turns it into the drain flag below
+                # (inert-flag fallback off the main thread)
+                if guard.installed:
+                    os.kill(os.getpid(), signal.SIGTERM)
+                else:
+                    guard.request()
+            if guard.requested:
+                # graceful drain: stop admission at this boundary; the
+                # in-flight window settles below and still-resident
+                # requests are journaled ``request-preempted``
+                preempted = True
+                break
             now = self._now()
             # 1. arrivals -> admission control (bounded queue)
             while pending and pending[0].arrival_s <= now:
@@ -1271,6 +1803,7 @@ class ServingEngine:
                 if reason is not None:
                     self._requests["rejected"] += 1
                     self._rejections["infeasible"] += 1
+                    outcomes[req.rid] = "rejected[infeasible]"
                     rejected_detail.append({
                         "rid": req.rid, "reason": "infeasible",
                         "queue_depth": len(queue), "queue_wait_s": 0.0,
@@ -1285,6 +1818,7 @@ class ServingEngine:
                                  else 0.0)
                     self._requests["rejected"] += 1
                     self._rejections["queue-full"] += 1
+                    outcomes[req.rid] = "rejected[queue-full]"
                     rejected_detail.append({
                         "rid": req.rid, "reason": "queue-full",
                         "queue_depth": len(queue),
@@ -1300,7 +1834,31 @@ class ServingEngine:
                     self._event("request-admitted", req.rid,
                                 queue_depth=len(queue))
             # 2. step-boundary scheduling: grant slots + block
-            #    reservations, prefill each granted request
+            #    reservations, prefill each granted request.  First,
+            #    per-request SLO shedding: a queue head whose wait has
+            #    already blown its deadline is shed
+            #    (``request-rejected[reason=deadline]`` — DISTINCT from
+            #    queue-full: this is latency, not capacity) rather than
+            #    served into a guaranteed SLO miss
+            while (queue and queue[0].deadline_s is not None
+                    and now - queue[0].arrival_s > queue[0].deadline_s):
+                req = queue.popleft()
+                wait = now - req.arrival_s
+                self._requests["rejected"] += 1
+                self._rejections["deadline"] += 1
+                self._deadline_counter["shed-queued"] += 1
+                stats.deadline_shed += 1
+                outcomes[req.rid] = "rejected[deadline]"
+                rejected_detail.append({
+                    "rid": req.rid, "reason": "deadline",
+                    "queue_depth": len(queue),
+                    "queue_wait_s": round(wait, 6),
+                    "deadline_s": req.deadline_s,
+                })
+                self._event("request-rejected", req.rid,
+                            reason="deadline",
+                            queue_wait_s=round(wait, 6),
+                            deadline_s=req.deadline_s)
             scheduled = False
             if queue and free_slots:
                 # scan boundary: settle in-flight decode before the
@@ -1314,73 +1872,12 @@ class ServingEngine:
                         req = queue.popleft()
                         slot = free_slots.pop(0)
                         ledger.reserve(slot, req.total_tokens)
-                        if cfg.prefill_chunk is not None:
-                            chunk = cfg.prefill_chunk
-                            n_chunks = -(-req.prompt_len // chunk)
-                            bucket = n_chunks * chunk
-                            x_prompt = request_embeddings(
-                                req.seed, req.prompt_len,
-                                self.config.hidden_size,
-                                dtype=self._dtype, pad_to=bucket,
-                            )
-                            with spans.span("serve-prefill", rid=req.rid,
-                                            bucket=bucket, slot=slot,
-                                            chunks=n_chunks):
-                                t0 = time.perf_counter()
-                                decode_spent = 0.0
-                                prefix = create_prefix(self.config,
-                                                       self.mesh)
-                                cache = carry[0]
-                                for ci in range(n_chunks):
-                                    with spans.span(
-                                            "serve-prefill-chunk",
-                                            rid=req.rid, chunk=ci):
-                                        cache, prefix, y_last = \
-                                            self._chunk_jit(ci)(
-                                                cache, prefix,
-                                                self.params,
-                                                x_prompt[:, ci * chunk:
-                                                         (ci + 1) * chunk],
-                                                np.int32(slot),
-                                                np.int32(req.prompt_len))
-                                    stats.prefill_chunks += 1
-                                    self.registry.inc(
-                                        "serve_prefill_chunks")
-                                    if ci < n_chunks - 1 and slots:
-                                        # interleave: the resident batch
-                                        # decodes between chunks instead
-                                        # of head-of-line blocking
-                                        carry = (cache, carry[1])
-                                        td = time.perf_counter()
-                                        dispatch_decode(max_k=1)
-                                        decode_spent += (
-                                            time.perf_counter() - td)
-                                        cache = carry[0]
-                                carry = (cache, carry[1])
-                                jax.block_until_ready(y_last)
-                                # the interleaved units' dispatch+sync
-                                # time is already billed to
-                                # decode_step_s/per_token_s — keep
-                                # prefill_s a PREFILL cost
-                                dt = (time.perf_counter() - t0
-                                      - decode_spent)
-                        else:
-                            bucket = cfg.bucket_for(req.prompt_len)
-                            x_prompt = request_embeddings(
-                                req.seed, req.prompt_len,
-                                self.config.hidden_size,
-                                dtype=self._dtype, pad_to=bucket,
-                            )
-                            with spans.span("serve-prefill", rid=req.rid,
-                                            bucket=bucket, slot=slot):
-                                t0 = time.perf_counter()
-                                cache, y_last = self._prefill(
-                                    carry[0], self.params, x_prompt,
-                                    np.int32(slot),
-                                    np.int32(req.prompt_len))
-                                jax.block_until_ready(y_last)
-                                dt = time.perf_counter() - t0
-                            carry = (cache, carry[1])
+                        try:
+                            bucket, y_last, dt = prefill_dispatch(req,
+                                                                  slot)
+                        except Exception as e:  # noqa: BLE001 — closed
+                            fail_admission(req, slot, e)
+                            continue
                         carry = self._inject(carry, np.int32(slot),
                                              y_last)
                         ledger.append(slot, req.prompt_len)
@@ -1435,6 +1932,31 @@ class ServingEngine:
                                     ledger.blocks_in_use,
                                     help="cache blocks holding tokens")
         drain()
+        remaining_rids: list[int] = []
+        if preempted:
+            # graceful drain: the in-flight window settled above;
+            # still-resident requests are preempted — journaled, freed,
+            # and replayed by ``cli serve --resume`` (serve/bench.py
+            # writes the ledger/queue/trace-cursor snapshot)
+            for s in sorted(list(slots)):
+                st = release(s)
+                outcomes[st.req.rid] = "preempted"
+                stats.preempted_requests += 1
+                self._requests["preempted"] += 1
+                remaining_rids.append(st.req.rid)
+                self._event("request-preempted", st.req.rid,
+                            tokens_done=st.tokens_done,
+                            output_len=st.req.output_len)
+            remaining_rids += [r.rid for r in queue]
+            remaining_rids += [r.rid for r in pending]
+            if self.journal is not None:
+                self.journal.event("preempted",
+                                   signal=guard.signal_received,
+                                   remaining=len(remaining_rids))
+            if self.verbose:
+                print(f"[serve] SIGTERM received — drained the in-flight "
+                      f"window, {len(remaining_rids)} request(s) remain "
+                      "for --resume")
         wall = self._now()
 
         self.registry.set_gauge("serve_queue_depth_peak",
@@ -1470,10 +1992,16 @@ class ServingEngine:
             "requests": {
                 **{k: self._requests[k] - counts_base[k]
                    for k in ("arrived", "admitted", "rejected",
-                             "completed")},
+                             "completed", "failed", "preempted")},
                 "rejected_rids": [d["rid"] for d in rejected_detail],
                 "rejected_detail": rejected_detail,
                 "shed_rate": (shed / arrived) if arrived else 0.0,
+                "deadline_shed": stats.deadline_shed,
+                "completed_past_deadline": stats.completed_past_deadline,
+                # rid -> final outcome: the per-request ground truth the
+                # kill-mid-trace ≡ uninterrupted chaos gate compares
+                "outcomes": {str(rid): o
+                             for rid, o in sorted(outcomes.items())},
             },
             "goodput_tokens_per_s": goodput,
             "throughput_tokens_per_s": (
@@ -1495,6 +2023,14 @@ class ServingEngine:
                 "prefill_chunks": stats.prefill_chunks,
                 "compacted_scans": stats.compacted_scans,
             },
+            "resilience": {
+                "retries": stats.retries,
+                "hung_dispatches": stats.hung_dispatches,
+                "failed_requests": stats.failed_requests,
+                "failed": failed_detail,
+            },
+            "preempted": preempted,
+            "remaining_rids": sorted(remaining_rids),
             "ttft": summarize(stats.ttft_s),
             "per_token_latency": summarize(stats.per_token_s),
             "e2e_latency": summarize(stats.e2e_latency_s),
@@ -1505,6 +2041,17 @@ class ServingEngine:
             "compile_time_s": compile_time,
             "wall_seconds": wall,
         }
+        if collect_raw or preempted:
+            # the raw sample lists: a preempted session's checkpoint
+            # carries them so the --resume merge can re-summarize over
+            # BOTH sessions instead of faking a merged percentile
+            report["raw_samples"] = {
+                "ttft_s": list(stats.ttft_s),
+                "per_token_s": list(stats.per_token_s),
+                "prefill_s": list(stats.prefill_s),
+                "decode_step_s": list(stats.decode_step_s),
+                "e2e_latency_s": list(stats.e2e_latency_s),
+            }
         if self.capture_tokens:
             report["completed_tokens"] = {
                 str(rid): toks for rid, toks in sorted(tokens_by_rid.items())

@@ -225,6 +225,22 @@ class BlockLedger:
         self._tokens.pop(slot)
         return blocks
 
+    def snapshot(self) -> dict[str, dict[int, int]]:
+        """Copy of the alloc/append accounting — the serving engine's
+        pre-dispatch rollback point (``docs/resilience.md``): a failed
+        or torn decode unit restores this before re-issuing."""
+        return {"reserved": dict(self._reserved),
+                "tokens": dict(self._tokens)}
+
+    def restore(self, snap: dict[str, dict[int, int]]) -> None:
+        """Roll the accounting back to a :meth:`snapshot`.  The peak
+        counters deliberately stay monotone (a rolled-back peak was
+        still a real high-water mark of host bookkeeping)."""
+        self._reserved.clear()
+        self._reserved.update(snap["reserved"])
+        self._tokens.clear()
+        self._tokens.update(snap["tokens"])
+
     def stats(self) -> dict[str, int]:
         return {
             "total_blocks": self.total_blocks,

@@ -47,13 +47,20 @@ class Request:
 
     arrival_s is relative to the start of the run (the engine's
     monotonic clock); ``seed`` derives the request's synthetic prompt
-    embeddings, so replaying a trace replays the exact inputs."""
+    embeddings, so replaying a trace replays the exact inputs.
+    ``deadline_s`` is an optional per-request SLO, in seconds from
+    *arrival*: the scheduler sheds a queued request whose wait has
+    already blown it (``request-rejected[reason=deadline]``), and a
+    request that completes past it is counted
+    ``completed_past_deadline`` (docs/serving.md).  Absent (None) means
+    no deadline — the pre-deadline trace schema is unchanged."""
 
     rid: int
     arrival_s: float
     prompt_len: int
     output_len: int
     seed: int
+    deadline_s: Optional[float] = None
 
     @property
     def total_tokens(self) -> int:
@@ -94,7 +101,13 @@ class TrafficTrace:
             "kind": self.kind,
             "seed": self.seed,
             "params": dict(self.params),
-            "requests": [asdict(r) for r in self.requests],
+            # deadline-free requests serialise exactly as the original
+            # v1 schema (no key), so committed traces stay byte-stable
+            "requests": [
+                {k: v for k, v in asdict(r).items()
+                 if k != "deadline_s" or v is not None}
+                for r in self.requests
+            ],
         }
 
     def save(self, path: "str | Path") -> Path:
@@ -116,7 +129,22 @@ class TrafficTrace:
 
     @classmethod
     def load(cls, path: "str | Path") -> "TrafficTrace":
-        return cls.from_dict(json.loads(Path(path).read_text()))
+        from dlbb_tpu.resilience import inject
+
+        text = Path(path).read_text()
+        if inject.fire("serve-trace-corrupt"):
+            # chaos harness: model a torn/corrupt trace file on disk —
+            # the load below must fail CLOSED with a chained error, and
+            # the caller must publish nothing
+            text = text[:int(len(text) * inject.param("torn_fraction"))]
+        try:
+            d = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(
+                f"serving trace {path} is corrupt or truncated "
+                "(refusing to serve a partial trace)"
+            ) from e
+        return cls.from_dict(d)
 
 
 def _lognormal_lengths(rng: np.random.Generator, n: int,
@@ -194,13 +222,16 @@ def generate_trace(
     dwell_s: float = 0.5,
     period_s: float = 4.0,
     depth: float = 0.8,
+    deadline_s: Optional[float] = None,
 ) -> TrafficTrace:
     """Generate a seeded, replayable trace.
 
     ``rate`` is the mean arrival rate in req/s (the calm-state rate for
     ``bursty``, the mean of the sinusoid for ``diurnal``); length bounds
-    are inclusive.  The same ``(kind, num_requests, seed, params)``
-    always yields the identical trace.
+    are inclusive.  ``deadline_s`` stamps every request with that SLO
+    (seconds from arrival; None = no deadlines, the original schema).
+    The same ``(kind, num_requests, seed, params)`` always yields the
+    identical trace.
     """
     if kind not in TRACE_KINDS:
         raise ValueError(
@@ -228,10 +259,16 @@ def generate_trace(
     seeds = rng.integers(0, 2**31 - 1, size=num_requests)
     params.update({"prompt_range": list(prompt_range),
                    "output_range": list(output_range)})
+    if deadline_s is not None:
+        if deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0 seconds, got {deadline_s}"
+            )
+        params["deadline_s"] = deadline_s
     requests = tuple(
         Request(rid=i, arrival_s=float(arrivals[i]),
                 prompt_len=int(prompts[i]), output_len=int(outputs[i]),
-                seed=int(seeds[i]))
+                seed=int(seeds[i]), deadline_s=deadline_s)
         for i in range(num_requests)
     )
     return TrafficTrace(kind=kind, seed=seed, params=params,

@@ -13,14 +13,15 @@ from typing import Any, Optional
 from dlbb_tpu.utils.config import atomic_write_text
 
 CSV_COLUMNS = (
-    "name", "trace", "requests", "completed", "rejected", "shed_rate",
+    "name", "trace", "requests", "completed", "rejected", "failed",
+    "shed_rate", "deadline_shed", "past_deadline",
     "rej_queue_wait_ms", "mesh",
     "max_batch", "block_size", "max_seq",
     "goodput_tok_s", "throughput_tok_s",
     "ttft_p50_ms", "ttft_p99_ms", "ttft_p999_ms",
     "per_token_p50_ms", "per_token_p99_ms", "per_token_p999_ms",
     "peak_queue_depth", "peak_blocks_in_use", "decode_steps",
-    "fused_steps", "prefill_chunks",
+    "fused_steps", "prefill_chunks", "retries",
     "wall_seconds",
 )
 
@@ -69,7 +70,11 @@ def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
         "requests": report.get("trace", {}).get("num_requests"),
         "completed": req.get("completed"),
         "rejected": req.get("rejected"),
+        "failed": req.get("failed"),
         "shed_rate": shed_rate,
+        "deadline_shed": req.get("deadline_shed"),
+        "past_deadline": req.get("completed_past_deadline"),
+        "retries": report.get("resilience", {}).get("retries"),
         "rej_queue_wait_ms": rej_wait_ms,
         "fused_steps": fast.get("fused_steps"),
         "prefill_chunks": fast.get("prefill_chunks"),
@@ -135,22 +140,33 @@ def write_serving_report(results_dir: "str | Path",
         "(high values = the queue bound is doing its job under real "
         "backlog; near-zero = capacity is set too low) — the "
         "admission-tuning signals (`requests.rejected_detail` carries "
-        "the per-rejection reason + wait).",
+        "the per-rejection reason + wait).  \"failed\" counts requests "
+        "failed closed by the resilience layer (dispatch failure / "
+        "hung dispatch, `docs/resilience.md`); \"late\" counts "
+        "requests COMPLETED past their per-request SLO deadline and "
+        "\"dl shed\" those shed from the queue because their deadline "
+        "had already passed (distinct from queue-full shedding).",
         "",
-        "| run | trace | req | done | rej | shed | rej wait ms | mesh | "
+        "| run | trace | req | done | rej | failed | shed | dl shed | "
+        "late | rej wait ms | mesh | "
         "goodput tok/s | "
         "TTFT p50/p99/p99.9 ms | tok p50/p99/p99.9 ms | peak queue | "
         "peak blocks |",
-        "|---|---|---|---|---|---|---|---|---|---|---|---|---|",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
+        "---|",
     ]
     for r in rows:
         shed = ("-" if r["shed_rate"] is None
                 else f"{r['shed_rate'] * 100:.0f}%")
         wait = ("-" if r["rej_queue_wait_ms"] is None
                 else r["rej_queue_wait_ms"])
+        failed = "-" if r["failed"] is None else r["failed"]
+        dl_shed = "-" if r["deadline_shed"] is None else r["deadline_shed"]
+        late = "-" if r["past_deadline"] is None else r["past_deadline"]
         lines.append(
             f"| {r['name']} | {r['trace']} | {r['requests']} | "
-            f"{r['completed']} | {r['rejected']} | {shed} | {wait} | "
+            f"{r['completed']} | {r['rejected']} | {failed} | {shed} | "
+            f"{dl_shed} | {late} | {wait} | "
             f"{r['mesh']} | "
             f"{r['goodput_tok_s']} | "
             f"{r['ttft_p50_ms']}/{r['ttft_p99_ms']}/{r['ttft_p999_ms']} | "

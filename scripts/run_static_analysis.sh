@@ -103,6 +103,23 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve_fastpath.py -q \
     -m serve_fastpath_smoke -p no:cacheprovider
 
+# serve_chaos_smoke (docs/resilience.md, serving faults): the serving
+# fault matrix through the real continuous-batching engine on the
+# simulated mesh — seeded mini-trace per serving fault class asserting
+# transient prefill/decode dispatch failures retry after rolling the
+# host ledger/slot state back to the pre-dispatch snapshot, exhausted
+# retries fail only the affected requests (journaled request-failed
+# with exception chains, never the run), the EMA-scaled watchdog
+# abandons a hung dispatch and the engine continues on a fresh carry,
+# torn bookkeeping replays, blown-SLO queue heads shed with
+# reason=deadline, no corrupt artifact survives, and SIGTERM-mid-trace
+# + `cli serve --resume` reproduces the uninterrupted artifact set
+# (names + schema + per-request outcomes for non-preempted requests).
+# The decode hot path stays provably injection-free: the static
+# zero-instruction pin on the fused-scan body runs in this same file.
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py -q \
+    -m serve_chaos_smoke -p no:cacheprovider
+
 # compressed-collective smoke (docs/compression.md): int8/fp8 allreduce_q
 # mini-sweep through the real engine + one compressed train step whose
 # losses track the uncompressed run — the HLO-side compression proof

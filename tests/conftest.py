@@ -87,6 +87,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "serve_chaos_smoke: serving resilience smoke — seeded "
+        "mini-traces per serving fault class (dispatch retry+rollback, "
+        "hung-dispatch watchdog, torn bookkeeping, per-request "
+        "deadlines, SIGTERM drain + resume equivalence) (tier-1; also "
+        "invoked standalone by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )
