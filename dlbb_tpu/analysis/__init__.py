@@ -62,6 +62,7 @@ def run_analysis(
     strict_warnings: bool = False,
     baselines: Optional[str] = None,
     tier: Optional[str] = None,
+    model: str = "cm1",
 ) -> int:
     """Run the requested passes; print the human summary; optionally write
     the JSON report.  Returns the pinned exit code: 0 clean / 1 findings /
@@ -72,6 +73,7 @@ def run_analysis(
         return _run_analysis(
             which=which, root=root, json_path=json_path, verbose=verbose,
             strict_warnings=strict_warnings, baselines=baselines, tier=tier,
+            model=model,
         )
     except Exception:  # noqa: BLE001 — the exit-code contract
         import traceback
@@ -88,6 +90,7 @@ def _run_analysis(
     strict_warnings: bool,
     baselines: Optional[str],
     tier: Optional[str],
+    model: str = "cm1",
 ) -> int:
     from dlbb_tpu.analysis.schedule_audit import DEFAULT_BASELINE_DIR
 
@@ -99,7 +102,8 @@ def _run_analysis(
         # imported lazily: the lint pass must work without touching jax
         from dlbb_tpu.analysis.hlo_audit import run_hlo_audit
 
-        hlo = run_hlo_audit(verbose=verbose, passes=hlo_passes, tier=tier)
+        hlo = run_hlo_audit(verbose=verbose, passes=hlo_passes, tier=tier,
+                            model=model)
         if not hlo.targets_audited:
             # every target skipped for lack of devices — a CI gate wired to
             # our exit code must not read that as a clean audit

@@ -33,10 +33,22 @@ unit tests and the sweep manifest writer run backend-free.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Optional
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
 
 COST_MODEL_VERSION = "cm1"
+
+# the fitted model: coefficients regressed from the measured sweep
+# corpus (dlbb_tpu/obs/{corpus,fit}.py) into a versioned DB under
+# stats/analysis/costmodel_fit/ — resolve_tier("...", model=CM2_VERSION)
+# loads the latest fit and prices with it
+CM2_VERSION = "cm2"
+KNOWN_MODELS = (COST_MODEL_VERSION, CM2_VERSION)
+
+FIT_SCHEMA = "dlbb_costmodel_fit_v1"
+DEFAULT_FIT_DIR = Path("stats/analysis/costmodel_fit")
 
 
 @dataclass(frozen=True)
@@ -48,6 +60,15 @@ class CostTier:
                         divided by ~1.05; 1 GB/s == 1000 bytes/µs).
     peak_flops_per_us:  dense-compute peak (FLOPs per µs; 1 TFLOP/s ==
                         1e6 FLOPs/µs).
+    gamma_dispatch_us:  per-dispatch host overhead (trace/launch/sync of
+                        one jitted program) — 0 in cm1 (un-modelled, the
+                        committed ~289x cpu-sim gap), fitted in cm2.
+    version:            the cost model the numbers came from ("cm1"
+                        analytic seeds, "cm2" fitted) — reports and
+                        baselines record this, and diff gates refuse to
+                        compare across it.
+    fit:                fit metadata (coefficient CIs, residuals, sample
+                        counts, fit_version) when version == "cm2".
     """
 
     name: str
@@ -55,6 +76,9 @@ class CostTier:
     beta_bytes_per_us: float
     peak_flops_per_us: float
     description: str = ""
+    gamma_dispatch_us: float = 0.0
+    version: str = COST_MODEL_VERSION
+    fit: Optional[dict] = field(default=None, compare=False)
 
 
 # version -> tier name -> CostTier.  Append-only: old versions stay so a
@@ -116,3 +140,116 @@ def collective_cost_us(wire_bytes: int, tier: CostTier) -> float:
 def compute_cost_us(flops: int, tier: CostTier) -> float:
     """FLOPs / peak: dense-compute time at the tier's peak throughput."""
     return flops / tier.peak_flops_per_us
+
+
+def dispatch_cost_us(dispatch_count: int, tier: CostTier) -> float:
+    """γ x dispatches: the host-side cost of launching ``dispatch_count``
+    jitted programs — the term cm1 omits (γ = 0) and cm2 fits.  A wall
+    prediction for one program execution is ``critical_path_us +
+    dispatch_cost_us(1, tier)``."""
+    return dispatch_count * tier.gamma_dispatch_us
+
+
+# ---------------------------------------------------------------------------
+# cm2: the fitted model (DB under stats/analysis/costmodel_fit/)
+# ---------------------------------------------------------------------------
+
+
+class FitMissingError(FileNotFoundError):
+    """cm2 was requested but no fitted DB exists for the tier."""
+
+
+def fit_db_path(tier: str,
+                directory: "Optional[str | Path]" = None) -> Path:
+    return Path(directory or DEFAULT_FIT_DIR) / f"{CM2_VERSION}_{tier}.json"
+
+
+def load_fitted_tier(
+    name: str,
+    directory: "Optional[str | Path]" = None,
+    fit_version: Optional[int] = None,
+) -> CostTier:
+    """The cm2 pricing tier: cm1's analytic seed overlaid with the
+    latest (or a pinned ``fit_version``) coefficients from the fitted
+    DB.  Raises :class:`FitMissingError` when no DB exists — callers
+    decide whether that falls back (``resolve_tier``) or fails."""
+    cm1 = get_tier(name)  # validates the tier name first
+    path = fit_db_path(name, directory)
+    if not path.exists():
+        raise FitMissingError(
+            f"no fitted cm2 DB for tier {name!r} at {path} — run "
+            "`python -m dlbb_tpu.cli obs fit --results results` and "
+            "commit the DB (docs/observability.md)"
+        )
+    db = json.loads(path.read_text())
+    versions = db.get("versions") or []
+    if not versions:
+        raise FitMissingError(f"fitted DB {path} holds no versions")
+    if fit_version is not None:
+        matches = [v for v in versions
+                   if v.get("fit_version") == fit_version]
+        if not matches:
+            raise FitMissingError(
+                f"fitted DB {path} has no fit_version {fit_version} "
+                f"(latest: {versions[-1].get('fit_version')})"
+            )
+        entry = matches[0]
+    else:
+        entry = versions[-1]
+    coeff = entry["coefficients"]
+
+    def _v(key: str, fallback: float) -> float:
+        c = coeff.get(key)
+        if isinstance(c, dict) and isinstance(c.get("value"), (int, float)):
+            return float(c["value"])
+        return fallback
+
+    meta: dict[str, Any] = {
+        "fit_version": entry.get("fit_version"),
+        "fitted_at": entry.get("fitted_at"),
+        "db_path": str(path),
+        "samples_used": entry.get("samples_used"),
+        "residuals": entry.get("residuals"),
+        "coefficients": coeff,
+        "alpha_pinned": entry.get("alpha_pinned"),
+        "peak_pinned": entry.get("peak_pinned"),
+    }
+    return CostTier(
+        name=name,
+        alpha_us=_v("alpha_us", cm1.alpha_us),
+        beta_bytes_per_us=_v("beta_bytes_per_us", cm1.beta_bytes_per_us),
+        peak_flops_per_us=_v("peak_flops_per_us", cm1.peak_flops_per_us),
+        gamma_dispatch_us=_v("gamma_dispatch_us", 0.0),
+        description=(f"fitted from the sweep corpus "
+                     f"(fit v{entry.get('fit_version')}); "
+                     f"seed: {cm1.description}"),
+        version=CM2_VERSION,
+        fit=meta,
+    )
+
+
+def resolve_tier(
+    name: Optional[str] = None,
+    model: str = COST_MODEL_VERSION,
+    fit_dir: "Optional[str | Path]" = None,
+    warn: bool = True,
+) -> CostTier:
+    """The one model-selection entry point (``--model cm1|cm2`` flows
+    here from the schedule auditor, ``obs calibrate`` and ``obs
+    attribute``).  cm2 with no committed fit falls back to the cm1
+    analytic constants with a LOUD warning — the returned tier's
+    ``version`` stays "cm1" so every report records which model actually
+    priced it."""
+    if model not in KNOWN_MODELS:
+        raise KeyError(
+            f"unknown cost model {model!r}; known: {list(KNOWN_MODELS)}"
+        )
+    if model == COST_MODEL_VERSION:
+        return get_tier(name)
+    try:
+        return load_fitted_tier(name or DEFAULT_TIER, fit_dir)
+    except FitMissingError as e:
+        if warn:
+            print(f"[costmodel] WARNING: fit-missing — {e}; "
+                  "falling back to cm1 analytic constants")
+        return get_tier(name)

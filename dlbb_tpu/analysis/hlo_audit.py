@@ -58,7 +58,8 @@ class AuditTarget:
 def audit_target(
     target: AuditTarget,
     passes: Sequence[str] = ("hlo",),
-    tier: Optional[str] = None,
+    tier: Optional[object] = None,
+    model: str = "cm1",
 ) -> tuple[list[Finding], dict]:
     """Lower, compile, parse, and check one target.  Returns the findings
     plus a meta dict (instruction inventory, and — when the ``schedule``
@@ -83,7 +84,7 @@ def audit_target(
         from dlbb_tpu.analysis.schedule_audit import analyze_schedule
 
         sched_findings, sched_meta = analyze_schedule(
-            module, exp, target.name, tier=tier,
+            module, exp, target.name, tier=tier, model=model,
         )
         findings.extend(sched_findings)
         meta["schedule"] = sched_meta
@@ -962,24 +963,27 @@ def run_hlo_audit(
     verbose: bool = False,
     passes: Sequence[str] = ("hlo",),
     tier: Optional[str] = None,
+    model: str = "cm1",
 ) -> AnalysisReport:
     """Audit ``targets`` (default: the standing registry) on the current
     backend.  ``passes`` selects the byte auditor (``"hlo"``), the α–β
     schedule auditor (``"schedule"``), or both — one lowering per target
-    either way.  Targets needing more devices than available are recorded
-    as skipped, not failed — the CLI's ``--simulate N`` controls the
-    mesh."""
+    either way.  ``model`` selects the cost model the schedule pass
+    prices with (cm1 analytic / cm2 fitted).  Targets needing more
+    devices than available are recorded as skipped, not failed — the
+    CLI's ``--simulate N`` controls the mesh."""
     import jax
 
     if "schedule" in passes:
         if tier is None:
             tier = default_tier()
-        # validate once, before any lowering: a mistyped --tier must be
-        # EXIT_CRASH (unusable arguments), not 30 repeated audit-crash
-        # findings after minutes of wasted compiles
-        from dlbb_tpu.analysis.costmodel import get_tier
+        # resolve once, before any lowering: a mistyped --tier/--model
+        # must be EXIT_CRASH (unusable arguments), not 30 repeated
+        # audit-crash findings after minutes of wasted compiles — and a
+        # cm2 fit-missing fallback must warn ONCE, not per target
+        from dlbb_tpu.analysis.costmodel import resolve_tier
 
-        get_tier(tier)
+        tier = resolve_tier(tier, model=model)
     report = AnalysisReport()
     n_devices = len(jax.devices())
     for target in targets if targets is not None else default_targets():

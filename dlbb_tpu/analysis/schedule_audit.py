@@ -55,7 +55,8 @@ from dlbb_tpu.analysis.costmodel import (
     CostTier,
     collective_cost_us,
     compute_cost_us,
-    get_tier,
+    dispatch_cost_us,
+    resolve_tier,
 )
 from dlbb_tpu.analysis.expectations import TargetExpectation, wire_bytes
 from dlbb_tpu.analysis.findings import (
@@ -313,9 +314,14 @@ class _ModuleAnalysis:
         entry = self.module.entry_computation()
         if entry is None:
             return {
-                "cost_model_version": COST_MODEL_VERSION,
+                "cost_model_version": self.tier.version,
                 "tier": self.tier.name,
                 "critical_path_us": 0.0,
+                "dispatch_count": 1,
+                "dispatch_overhead_us": round(
+                    dispatch_cost_us(1, self.tier), 6),
+                "predicted_wall_us": round(
+                    dispatch_cost_us(1, self.tier), 6),
                 "comm_on_critical_path_us": 0.0,
                 "comm_total_us": 0.0,
                 "compute_total_us": 0.0,
@@ -326,6 +332,7 @@ class _ModuleAnalysis:
                 "collectives": [],
             }
         entry_stats = self._analyze_comp(entry)
+        dispatch_overhead = dispatch_cost_us(1, self.tier)
         # fused computations are priced at their fusion call site
         # (_fusion_flops feeds the caller's flops[] and compute_total);
         # walking them again here would double-count their dots.  They
@@ -357,9 +364,17 @@ class _ModuleAnalysis:
                     kinds[c["kind"]] = kinds.get(c["kind"], 0) + mult
                 collectives.append({**c, "execution_count": mult})
         return {
-            "cost_model_version": COST_MODEL_VERSION,
+            "cost_model_version": self.tier.version,
             "tier": self.tier.name,
             "critical_path_us": round(entry_stats.critical_path_us, 6),
+            # the wall prediction for ONE execution of this program:
+            # critical path + γ x 1 host dispatch (γ = 0 under cm1 — the
+            # un-modelled term the cm2 fit supplies)
+            "dispatch_count": 1,
+            "dispatch_overhead_us": round(dispatch_overhead, 6),
+            "predicted_wall_us": round(
+                entry_stats.critical_path_us + dispatch_overhead, 6
+            ),
             "comm_on_critical_path_us": round(entry_stats.comm_on_cp_us, 6),
             "comm_total_us": round(comm_total, 6),
             "compute_total_us": round(compute_total, 6),
@@ -481,13 +496,17 @@ def analyze_schedule(
     hlo: "str | HloModule",
     expectation: TargetExpectation,
     target: str,
-    tier: Optional[str] = None,
+    tier: "Optional[str | CostTier]" = None,
+    model: str = COST_MODEL_VERSION,
 ) -> tuple[list[Finding], dict]:
     """Run the schedule audit over one compiled module.  Returns the
     findings plus the per-target schedule meta (the JSON-report /
-    baseline payload)."""
+    baseline payload).  ``model`` selects cm1 (analytic constants) or
+    cm2 (the fitted DB, falling back loudly when absent); a pre-resolved
+    :class:`CostTier` may be passed directly as ``tier``."""
     module = hlo if isinstance(hlo, HloModule) else parse_module(hlo)
-    cost_tier = get_tier(tier)
+    cost_tier = (tier if isinstance(tier, CostTier)
+                 else resolve_tier(tier, model=model))
     findings: list[Finding] = []
 
     meta = _ModuleAnalysis(module, cost_tier).analyze()

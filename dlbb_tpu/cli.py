@@ -182,6 +182,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "(cpu-sim, tpu-v5lite, tpu-v5lite-dcn; default: "
                          "auto from the backend — see "
                          "analysis/costmodel.py)")
+    an.add_argument("--model", default="cm1", choices=("cm1", "cm2"),
+                    help="cost model the schedule audit prices with: cm1 "
+                         "= analytic seed constants, cm2 = coefficients "
+                         "fitted from the sweep corpus "
+                         "(stats/analysis/costmodel_fit/; falls back to "
+                         "cm1 with a fit-missing warning)")
 
     ob = sub.add_parser(
         "obs",
@@ -191,13 +197,20 @@ def build_parser() -> argparse.ArgumentParser:
              "(diff) — exit codes pinned 0 clean / 1 findings / 2 crash "
              "(docs/observability.md)",
     )
-    ob.add_argument("which", choices=("trace", "calibrate", "diff"),
+    ob.add_argument("which", choices=("trace", "calibrate", "diff",
+                                      "fit", "attribute"),
                     help="trace = rebuild a Perfetto timeline from a "
                          "sweep's journal; calibrate = measure every "
                          "committed schedule-baseline target and report "
                          "signed predicted-vs-measured error; diff = fail "
                          "when the model error regressed past the "
-                         "committed calibration baseline")
+                         "committed calibration baseline; fit = regress "
+                         "cm2 (α, β, peak, per-dispatch γ) from the "
+                         "sweep-artifact corpus into the versioned "
+                         "fitted DB; attribute = join a run's span "
+                         "trace/journal against the cost model into a "
+                         "per-phase 'where did the time go' report "
+                         "(MD+CSV under stats/analysis/attribution/)")
     ob.add_argument("--journal", default=None, metavar="DIR",
                     help="sweep output directory holding "
                          "sweep_journal.jsonl (obs trace)")
@@ -225,6 +238,30 @@ def build_parser() -> argparse.ArgumentParser:
                          "(calibrate/diff subset runs)")
     ob.add_argument("--strict-warnings", action="store_true",
                     help="exit nonzero on warnings too")
+    ob.add_argument("--model", default="cm1", choices=("cm1", "cm2"),
+                    help="cost model for calibrate/diff/attribute: cm1 "
+                         "analytic constants, cm2 the fitted DB "
+                         "(docs/observability.md)")
+    ob.add_argument("--fit-dir", default=None, metavar="DIR",
+                    dest="fit_dir",
+                    help="fitted-DB directory (default "
+                         "stats/analysis/costmodel_fit; obs fit writes "
+                         "here, cm2 pricing reads here)")
+    ob.add_argument("--results", nargs="+", default=None, metavar="DIR",
+                    help="results tree(s) the fit ingests (obs fit; "
+                         "default: results)")
+    ob.add_argument("--span-trace-file", default=None, metavar="FILE",
+                    dest="span_trace_file",
+                    help="explicit span-trace JSON for obs attribute "
+                         "(default: auto-detect in --journal DIR)")
+    ob.add_argument("--min-samples", type=int, default=None,
+                    dest="min_samples",
+                    help="minimum corpus samples per tier before the fit "
+                         "refuses (obs fit; default 16)")
+    ob.add_argument("--host", default=None, dest="host_filter",
+                    help="substring filter on the corpus host "
+                         "fingerprint (obs fit): fit the tier for the "
+                         "host you will predict on")
 
     ch = sub.add_parser(
         "chaos",
@@ -655,7 +692,7 @@ def _dispatch(args) -> int:
         return run_analysis(
             which=args.which, root=args.root, json_path=args.json,
             strict_warnings=args.strict_warnings,
-            baselines=args.baselines, tier=args.tier,
+            baselines=args.baselines, tier=args.tier, model=args.model,
         )
 
     if args.cmd == "obs":
@@ -666,7 +703,10 @@ def _dispatch(args) -> int:
             baselines=args.baselines, calibration=args.calibration,
             report=args.report, tier=args.tier, reps=args.reps,
             warmup=args.warmup, targets=args.targets,
-            strict_warnings=args.strict_warnings,
+            strict_warnings=args.strict_warnings, model=args.model,
+            fit_dir=args.fit_dir, results=args.results,
+            trace=args.span_trace_file, min_samples=args.min_samples,
+            host_filter=args.host_filter,
         )
 
     if args.cmd == "chaos":

@@ -27,10 +27,21 @@ the loop:
 - :mod:`~dlbb_tpu.obs.export` — a small counters/gauges metrics registry
   with labels that backs the sweep-manifest aggregates and a
   Prometheus-textfile export (``metrics.prom`` next to the manifest).
+- :mod:`~dlbb_tpu.obs.corpus` + :mod:`~dlbb_tpu.obs.fit` — the cm2
+  fitted cost model: the sweep-artifact corpus normalised into a sample
+  table and robustly regressed (per-tier α, β, peak, per-dispatch γ —
+  the term behind cm1's committed ~289x cpu-sim gap) into the
+  append-only versioned DB ``stats/analysis/costmodel_fit/`` that
+  ``--model cm2`` prices with (``cli obs fit``).
+- :mod:`~dlbb_tpu.obs.attribution` — span-level time attribution
+  (``cli obs attribute``): a run's span trace / journal partitioned
+  into phases and joined against the fitted model's
+  dispatch-overhead / wire / compute decomposition, per config and per
+  serving request (MD + CSV under ``stats/analysis/attribution/``).
 
-CLI: ``python -m dlbb_tpu.cli obs {trace,calibrate,diff}``.  Exit codes
-follow the pinned ``analysis.findings.EXIT_*`` contract: 0 clean /
-1 findings / 2 crash.  See ``docs/observability.md``.
+CLI: ``python -m dlbb_tpu.cli obs {trace,calibrate,diff,fit,attribute}``.
+Exit codes follow the pinned ``analysis.findings.EXIT_*`` contract:
+0 clean / 1 findings / 2 crash.  See ``docs/observability.md``.
 """
 
 from __future__ import annotations
@@ -58,6 +69,12 @@ def run_obs(
     targets: Optional[list[str]] = None,
     strict_warnings: bool = False,
     verbose: bool = True,
+    model: str = "cm1",
+    fit_dir: Optional[str] = None,
+    results: Optional[list[str]] = None,
+    trace: Optional[str] = None,
+    min_samples: Optional[int] = None,
+    host_filter: Optional[str] = None,
 ) -> int:
     """CLI driver for the ``obs`` subcommands.  Same exit-code contract
     as ``analysis.run_analysis``: any internal exception surfaces as
@@ -68,6 +85,8 @@ def run_obs(
             baselines=baselines, calibration=calibration, report=report,
             tier=tier, reps=reps, warmup=warmup, targets=targets,
             strict_warnings=strict_warnings, verbose=verbose,
+            model=model, fit_dir=fit_dir, results=results, trace=trace,
+            min_samples=min_samples, host_filter=host_filter,
         )
     except Exception:  # noqa: BLE001 — the exit-code contract
         import traceback
@@ -89,6 +108,12 @@ def _run_obs(
     targets: Optional[list[str]],
     strict_warnings: bool,
     verbose: bool,
+    model: str = "cm1",
+    fit_dir: Optional[str] = None,
+    results: Optional[list[str]] = None,
+    trace: Optional[str] = None,
+    min_samples: Optional[int] = None,
+    host_filter: Optional[str] = None,
 ) -> int:
     from pathlib import Path
 
@@ -106,6 +131,50 @@ def _run_obs(
                   + (f" ({torn} torn line(s) skipped)" if torn else ""))
         return EXIT_CLEAN
 
+    if which == "fit":
+        from dlbb_tpu.obs.fit import MIN_SAMPLES, FitError, run_fit
+
+        try:
+            out = run_fit(
+                results=results or ["results"],
+                tiers=[tier] if tier else None,
+                fit_dir=fit_dir or output,
+                min_samples=(min_samples if min_samples is not None
+                             else MIN_SAMPLES),
+                host_filter=host_filter,
+                verbose=verbose,
+                baselines_dir=baselines,
+            )
+        except FitError as e:
+            # a degenerate corpus is a FINDING (exit 1) under the pinned
+            # exit-code contract, not a harness crash (exit 2); run_fit
+            # raises whenever no tier fits, so out["fits"] is non-empty
+            # past this point
+            print(f"[obs] fit refused: {e}")
+            return EXIT_FINDINGS
+        return EXIT_CLEAN
+
+    if which == "attribute":
+        from dlbb_tpu.obs.attribution import (
+            run_attribution,
+            validate_attribution,
+        )
+
+        if not journal:
+            print("error: obs attribute needs --journal DIR (a sweep or "
+                  "serving output directory)")
+            return EXIT_CRASH
+        record = run_attribution(
+            input_dir=journal, out_dir=output, trace=trace, model=model,
+            tier=tier, fit_dir=fit_dir, verbose=verbose,
+        )
+        problems = validate_attribution(record)
+        if problems:
+            for p in problems:
+                print(f"[obs] attribution problem: {p}")
+            return EXIT_FINDINGS
+        return EXIT_CLEAN
+
     from dlbb_tpu.obs import calibration as cal
 
     if which == "calibrate":
@@ -113,7 +182,8 @@ def _run_obs(
         rep = cal.run_calibration(
             baselines_dir=Path(baselines) if baselines else None,
             out_dir=out_dir, tier=tier, reps=reps, warmup=warmup,
-            target_filter=targets, verbose=verbose,
+            target_filter=targets, verbose=verbose, model=model,
+            fit_dir=fit_dir,
         )
         agg = rep["aggregate"]
         if not rep["targets"]:
@@ -147,11 +217,15 @@ def _run_obs(
             rep_obj = cal.run_calibration(
                 baselines_dir=Path(baselines) if baselines else None,
                 out_dir=out_dir, tier=tier, reps=reps, warmup=warmup,
-                target_filter=targets, verbose=verbose,
+                target_filter=targets, verbose=verbose, model=model,
+                fit_dir=fit_dir,
             )
         base_dir = (Path(calibration) if calibration
                     else cal.DEFAULT_CALIBRATION_DIR)
-        findings = cal.diff_calibration(rep_obj, base_dir)
+        # the requested-model pin only applies when THIS run produced the
+        # report (--report hands in a pre-priced one, whose model rules)
+        findings = cal.diff_calibration(
+            rep_obj, base_dir, requested_model=None if report else model)
         result = AnalysisReport(findings=findings)
         if verbose:
             print(result.render_summary())

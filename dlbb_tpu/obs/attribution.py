@@ -1,0 +1,704 @@
+"""Span-level time attribution (``cli obs attribute``).
+
+The span tracer (PR 8) records *when* every harness phase ran; the
+fitted cost model (cm2) predicts *how long* the device work should
+take.  This module joins the two into a "where did the time go"
+breakdown for one run directory — per phase (queue-wait / compile /
+prefill / decode / execute / write / idle), per sweep config, and per
+serving request — with the cm2 prediction decomposed into its
+dispatch-overhead / collective-wire / compute terms next to the
+measured number, emitted as MD + CSV under
+``stats/analysis/attribution/``.
+
+Inputs, in preference order:
+
+- a **span trace** (Chrome trace-event JSON written via
+  ``--span-trace``/``DLBB_SPANS``): the main track's timeline is
+  partitioned exactly — every instant of the wall belongs to the
+  innermost phase-mapped span covering it, to ``host`` (inside an
+  unmapped span, e.g. the per-config glue), or to ``idle`` (no span
+  open).  Phase times therefore sum to the track's wall time by
+  construction.
+- a **journal** (``sweep_journal.jsonl``) when no trace exists — the
+  committed serving run's case: the last session's event stream is
+  segmented and each inter-event interval is attributed to the phase
+  the *ending* event closes (``request-admitted`` closes queue-wait,
+  ``request-prefill`` a prefill, ``request-completed`` decode work,
+  ...).  Coarser than spans, still a complete partition.
+
+Predictions come from :func:`dlbb_tpu.analysis.costmodel.resolve_tier`
+(``--model cm1|cm2``): sweep configs re-use the corpus feature
+extractor (:mod:`dlbb_tpu.obs.corpus`) on each artifact — per timed
+iteration ``γ + α·collectives + wire/β + FLOPs/peak`` — and serving
+runs price their recorded dispatch counts (``decode_units``, admitted
+prefills) with per-layer tp-collective counts and an analytic
+dense-forward FLOPs estimate from the report's model record.  The
+per-request table is measured-only (a decode dispatch serves the whole
+batch, so charging it to one request would double-count); the
+predicted-vs-measured comparison lives at the phase level where
+dispatch counts are exact.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Any, Optional
+
+from dlbb_tpu.analysis.costmodel import (
+    COST_MODEL_VERSION,
+    CostTier,
+    resolve_tier,
+)
+
+ATTRIBUTION_SCHEMA = "dlbb_attribution_v1"
+DEFAULT_ATTRIBUTION_DIR = Path("stats/analysis/attribution")
+
+# ordered phase vocabulary of the partition (every measured second of
+# the wall lands in exactly one)
+PHASES = ("queue-wait", "plan", "compile", "payload", "prefill",
+          "decode", "execute", "write", "capture", "host", "idle")
+
+# span name -> phase (innermost mapped span wins; prefix match for the
+# dynamic names)
+_SPAN_PHASE = {
+    "plan": "plan",
+    "compile": "compile",
+    "compile+warmup": "compile",
+    "compile-wait": "compile",
+    "payload": "payload",
+    "measure": "execute",
+    "train_step": "execute",
+    "device-capture": "capture",
+    "write": "write",
+    "serve-admission": "queue-wait",
+    "serve-prefill": "prefill",
+    "serve-prefill-chunk": "prefill",
+    "serve-decode": "decode",
+}
+_SPAN_PHASE_PREFIX = (("calibrate:", "execute"),)
+
+# journal event -> phase of the interval ENDING at that event
+_JOURNAL_PHASE = {
+    "request-admitted": "queue-wait",
+    "request-rejected": "queue-wait",
+    "request-infeasible": "queue-wait",
+    "request-prefill": "prefill",
+    "request-completed": "decode",
+    "request-failed": "decode",
+    "request-preempted": "decode",
+    "completed": "execute",
+    "failed": "execute",
+    "retry": "execute",
+}
+
+CSV_COLUMNS = (
+    "kind", "name", "measured_us", "queue_wait_us", "prefill_us",
+    "decode_us", "compile_us", "execute_us", "predicted_execute_us",
+    "predicted_dispatch_overhead_us", "predicted_wire_us",
+    "predicted_compute_us", "dispatches", "iterations", "tokens",
+    "error_factor", "outcome",
+)
+
+
+def _infer_tier(input_dir: Path) -> str:
+    """Cost-model tier from the run's artifacts (they record the backend
+    they measured on — ``corpus.tier_of_result``); ``cpu-sim`` when
+    nothing under the directory records one."""
+    from dlbb_tpu.obs.corpus import tier_of_result
+
+    for path in sorted(Path(input_dir).glob("*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(data, dict) and isinstance(
+                data.get("system_info"), dict):
+            return tier_of_result(data)
+    return "cpu-sim"
+
+
+def _span_phase(name: str) -> Optional[str]:
+    phase = _SPAN_PHASE.get(name)
+    if phase:
+        return phase
+    for prefix, p in _SPAN_PHASE_PREFIX:
+        if name.startswith(prefix):
+            return p
+    return None
+
+
+# ---------------------------------------------------------------------------
+# measured partition
+# ---------------------------------------------------------------------------
+
+
+def partition_trace(events: list[dict[str, Any]]
+                    ) -> tuple[dict[str, float], float, dict]:
+    """Partition the busiest track's timeline into phase micro-seconds.
+    Returns ``(phase_us, wall_us, per_name_us)``; phases + idle sum to
+    ``wall_us`` exactly."""
+    # pick the track (pid, tid) carrying the most B/E span time
+    totals: dict[tuple, float] = {}
+    opens: dict[tuple, dict[str, list[float]]] = {}
+    for ev in events:
+        if ev.get("ph") not in ("B", "E"):
+            continue
+        key = (ev.get("pid"), ev.get("tid"))
+        stack = opens.setdefault(key, {})
+        if ev["ph"] == "B":
+            stack.setdefault(ev["name"], []).append(ev["ts"])
+        else:
+            starts = stack.get(ev["name"])
+            if starts:
+                totals[key] = totals.get(key, 0.0) + ev["ts"] - starts.pop()
+    if not totals:
+        return {}, 0.0, {}
+    track = max(totals, key=lambda k: totals[k])
+
+    track_events = sorted(
+        (ev for ev in events
+         if ev.get("ph") in ("B", "E")
+         and (ev.get("pid"), ev.get("tid")) == track),
+        key=lambda ev: ev["ts"],
+    )
+    phase_us: dict[str, float] = {}
+    per_name: dict[str, float] = {}
+    stack: list[str] = []
+    prev_ts = track_events[0]["ts"]
+    for ev in track_events:
+        ts = ev["ts"]
+        if ts > prev_ts:
+            phase = "idle"
+            for name in reversed(stack):
+                mapped = _span_phase(name)
+                if mapped:
+                    phase = mapped
+                    break
+            else:
+                if stack:
+                    phase = "host"
+            phase_us[phase] = phase_us.get(phase, 0.0) + ts - prev_ts
+            if stack:
+                per_name[stack[-1]] = per_name.get(stack[-1], 0.0) \
+                    + ts - prev_ts
+        prev_ts = ts
+        if ev["ph"] == "B":
+            stack.append(ev["name"])
+        elif stack and stack[-1] == ev["name"]:
+            stack.pop()
+        elif ev["name"] in stack:  # tolerate mild misnesting
+            stack.remove(ev["name"])
+    wall = track_events[-1]["ts"] - track_events[0]["ts"]
+    return phase_us, wall, per_name
+
+
+def last_session(records: list[dict[str, Any]]) -> list[dict[str, Any]]:
+    """Journals are append-only across runs; attribute the LAST session
+    only (request ids repeat across sessions)."""
+    start = 0
+    for i, rec in enumerate(records):
+        if rec.get("event") == "sweep-start":
+            start = i
+    return records[start:]
+
+
+def partition_journal(records: list[dict[str, Any]]
+                      ) -> tuple[dict[str, float], float]:
+    """Segment the journal's event stream: each inter-event interval is
+    attributed to the phase its ending event closes (unknown enders →
+    idle).  Phases sum to the stream's wall time exactly."""
+    recs = [r for r in records if "ts" in r]
+    recs.sort(key=lambda r: float(r["ts"]))
+    phase_us: dict[str, float] = {}
+    prev = None
+    for rec in recs:
+        ts = float(rec["ts"])
+        if prev is not None and ts > prev:
+            phase = _JOURNAL_PHASE.get(rec.get("event"), "idle")
+            phase_us[phase] = phase_us.get(phase, 0.0) + (ts - prev) * 1e6
+        prev = ts
+    wall = (float(recs[-1]["ts"]) - float(recs[0]["ts"])) * 1e6 \
+        if len(recs) > 1 else 0.0
+    return phase_us, wall
+
+
+# ---------------------------------------------------------------------------
+# predictions
+# ---------------------------------------------------------------------------
+
+
+def predict_iteration_us(sample: dict[str, Any], tier: CostTier
+                         ) -> dict[str, float]:
+    """cm-priced decomposition of ONE timed iteration of a corpus-shaped
+    sample: {dispatch, wire, compute, total} in µs."""
+    dispatch = sample.get("dispatches", 1.0) * tier.gamma_dispatch_us
+    wire = (sample.get("collectives", 1.0) * tier.alpha_us
+            + sample["wire_bytes"] / tier.beta_bytes_per_us)
+    compute = sample.get("flops", 0) / tier.peak_flops_per_us
+    return {"dispatch": dispatch, "wire": wire, "compute": compute,
+            "total": dispatch + wire + compute}
+
+
+def _serving_dispatch_features(report: dict[str, Any]
+                               ) -> dict[str, dict[str, float]]:
+    """Analytic per-dispatch features of the serving engine's two jit
+    families, from the report's model/mesh/serving records: decode = one
+    token per active slot through the stack (≈ 24·L·h² FLOPs/token, two
+    tp psums per layer when tp > 1), prefill = one bucket of prompt
+    tokens.  Approximations — the attribution is about magnitudes, the
+    audit targets pin the exact inventories."""
+    model = report.get("model", {})
+    mesh = report.get("mesh", {})
+    serving = report.get("serving", {})
+    h = int(model.get("hidden_size", 0) or 0)
+    layers = int(model.get("num_layers", 0) or 0)
+    tp = int(mesh.get("tp", 1) or 1)
+    max_batch = int(serving.get("max_batch", 1) or 1)
+    dtype_bytes = 4 if "32" in str(model.get("dtype", "")) else 2
+    flops_token = 24 * layers * h * h
+    coll = (2 * layers) if tp > 1 else 0
+    # per-token activation psum: [1, h] partial per layer
+    wire_token = (2 * (tp - 1) / tp * h * dtype_bytes * coll
+                  if tp > 1 else 0)
+    buckets = serving.get("prefill_buckets") or [serving.get("max_seq", 0)]
+    mean_bucket = sum(buckets) / max(len(buckets), 1)
+    return {
+        "decode": {"collectives": float(coll),
+                   "wire_bytes": float(wire_token * max_batch),
+                   "flops": float(flops_token * max_batch),
+                   "dispatches": 1.0},
+        "prefill": {"collectives": float(coll),
+                    "wire_bytes": float(wire_token * mean_bucket),
+                    "flops": float(flops_token * mean_bucket),
+                    "dispatches": 1.0},
+    }
+
+
+# ---------------------------------------------------------------------------
+# the attribute run
+# ---------------------------------------------------------------------------
+
+
+def _find_span_trace(directory: Path,
+                     trace: "Optional[str | Path]") -> Optional[dict]:
+    from dlbb_tpu.obs.spans import SPAN_SCHEMA
+
+    candidates = [Path(trace)] if trace else sorted(directory.glob("*.json"))
+    for path in candidates:
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            if trace:
+                # an EXPLICIT --span-trace-file must fail loudly — a
+                # silent fallback to the coarser journal partition would
+                # hide that the named trace was never read
+                raise FileNotFoundError(
+                    f"--span-trace-file {path}: unreadable ({e})"
+                ) from e
+            continue
+        # a journal-RECONSTRUCTED trace (``obs trace`` output, often
+        # sitting in the same directory) carries the span schema but only
+        # M/i/X events — partitioning it would yield an empty wall=0
+        # report; only a real span trace (B/E pairs) qualifies
+        if (isinstance(data, dict)
+                and data.get("otherData", {}).get("schema") == SPAN_SCHEMA
+                and any(ev.get("ph") in ("B", "E")
+                        for ev in data.get("traceEvents", ())
+                        if isinstance(ev, dict))):
+            return data
+        if trace:
+            raise ValueError(
+                f"--span-trace-file {path} is not a span trace "
+                "(wrong/missing otherData.schema, or no B/E span events "
+                "— a journal-reconstructed `obs trace` output does not "
+                "qualify)"
+            )
+    return None
+
+
+def run_attribution(
+    input_dir: "str | Path",
+    out_dir: "Optional[str | Path]" = None,
+    trace: "Optional[str | Path]" = None,
+    model: str = COST_MODEL_VERSION,
+    tier: Optional[str] = None,
+    fit_dir: "Optional[str | Path]" = None,
+    name: Optional[str] = None,
+    verbose: bool = True,
+) -> dict[str, Any]:
+    """Attribute one run directory; writes ``<name>.md`` + ``<name>.csv``
+    under ``out_dir`` (default ``stats/analysis/attribution/``) and
+    returns the attribution record."""
+    from dlbb_tpu.resilience.journal import read_journal
+
+    input_dir = Path(input_dir)
+    out_dir = Path(out_dir or DEFAULT_ATTRIBUTION_DIR)
+    name = name or input_dir.resolve().name
+    if tier is None:
+        # file processing must stay backend-free: infer the tier from
+        # the artifacts (they record their backend), default cpu-sim
+        tier = _infer_tier(input_dir)
+    cost_tier = resolve_tier(tier, model=model, fit_dir=fit_dir)
+
+    records, torn = read_journal(input_dir)
+    session = last_session(records)
+    trace_data = _find_span_trace(input_dir, trace)
+    if trace_data is not None:
+        phase_us, wall_us, _names = partition_trace(
+            trace_data["traceEvents"])
+        source = "span-trace"
+    elif session:
+        phase_us, wall_us = partition_journal(session)
+        source = "journal"
+    else:
+        raise FileNotFoundError(
+            f"{input_dir} holds neither a span trace nor a parseable "
+            "journal — nothing to attribute (run with --span-trace, or "
+            "point --input at a sweep/serving output directory)"
+        )
+
+    serving = any(str(r.get("event", "")).startswith("request-")
+                  for r in session)
+    if serving:
+        entities, predicted = _serving_entities(input_dir, session,
+                                                cost_tier)
+    else:
+        entities, predicted = _sweep_entities(input_dir, session,
+                                              cost_tier)
+
+    record = {
+        "schema": ATTRIBUTION_SCHEMA,
+        "name": name,
+        "input_dir": str(input_dir),
+        "source": source,
+        "kind": "serving" if serving else "sweep",
+        "tier": cost_tier.name,
+        "cost_model_version": cost_tier.version,
+        "fit_version": (cost_tier.fit or {}).get("fit_version"),
+        "wall_us": wall_us,
+        "phases_us": {p: phase_us.get(p, 0.0) for p in PHASES
+                      if phase_us.get(p)},
+        "predicted_us": predicted,
+        "entities": entities,
+        "torn_journal_lines": torn,
+    }
+    md_path, csv_path = write_attribution(record, out_dir)
+    record["md_path"], record["csv_path"] = str(md_path), str(csv_path)
+    if verbose:
+        total = sum(record["phases_us"].values())
+        print(f"[obs] attribution ({record['kind']}, {source}, "
+              f"{cost_tier.version}): wall {wall_us / 1e6:.2f}s, "
+              f"phases cover {total / max(wall_us, 1e-9) * 100:.1f}% "
+              f"-> {md_path}")
+    return record
+
+
+def _sweep_entities(input_dir: Path, session: list[dict],
+                    tier: CostTier) -> tuple[list[dict], dict]:
+    """Per-config rows: journal lifecycle joined with each artifact's
+    corpus features, priced per iteration."""
+    from dlbb_tpu.obs.corpus import ingest_result
+
+    started: dict[str, float] = {}
+    done: dict[str, tuple[float, str]] = {}
+    for rec in session:
+        cfg, ev = rec.get("config"), rec.get("event")
+        if not cfg:
+            continue
+        if ev == "started":
+            started[cfg] = float(rec["ts"])
+        elif ev in ("completed", "failed"):
+            done[cfg] = (float(rec["ts"]), ev)
+
+    entities: list[dict] = []
+    pred_totals = {"dispatch": 0.0, "wire": 0.0, "compute": 0.0,
+                   "total": 0.0}
+    configs = sorted(set(started) | set(done)) or sorted(
+        p.name for p in input_dir.glob("*.json")
+        if p.name != "sweep_manifest.json"
+    )
+    for cfg in configs:
+        path = input_dir / cfg
+        row: dict[str, Any] = {"kind": "config", "name": cfg}
+        if cfg in started and cfg in done:
+            row["measured_us"] = (done[cfg][0] - started[cfg]) * 1e6
+            row["outcome"] = done[cfg][1]
+        sample = None
+        if path.exists():
+            try:
+                data = json.loads(path.read_text())
+                sample, _ = ingest_result(path, data)
+                if sample is not None:
+                    row["compile_us"] = float(
+                        data.get("compile_seconds", 0.0)) * 1e6
+            except (OSError, json.JSONDecodeError):
+                pass
+        if sample is not None:
+            iters = sample["iterations"]
+            per_iter = predict_iteration_us(sample, tier)
+            row.update(
+                iterations=iters,
+                dispatches=iters * sample.get("dispatches", 1.0),
+                execute_us=sample["measured_median_us"] * iters,
+                predicted_execute_us=per_iter["total"] * iters,
+                predicted_dispatch_overhead_us=per_iter["dispatch"] * iters,
+                predicted_wire_us=per_iter["wire"] * iters,
+                predicted_compute_us=per_iter["compute"] * iters,
+            )
+            if row["predicted_execute_us"] > 0 and row["execute_us"] > 0:
+                m, p = row["execute_us"], row["predicted_execute_us"]
+                row["error_factor"] = max(m, p) / min(m, p)
+            for k, kk in (("dispatch", "predicted_dispatch_overhead_us"),
+                          ("wire", "predicted_wire_us"),
+                          ("compute", "predicted_compute_us"),
+                          ("total", "predicted_execute_us")):
+                pred_totals[k] += row[kk]
+        entities.append(row)
+    return entities, {
+        "execute": pred_totals["total"],
+        "dispatch-overhead": pred_totals["dispatch"],
+        "collective-wire": pred_totals["wire"],
+        "compute": pred_totals["compute"],
+    }
+
+
+def _serving_entities(input_dir: Path, session: list[dict],
+                      tier: CostTier) -> tuple[list[dict], dict]:
+    """Per-request measured rows (queue-wait / prefill / decode from the
+    journal lifecycle) + phase-level predictions from the run report's
+    exact dispatch counts."""
+    report: dict[str, Any] = {}
+    for path in sorted(input_dir.glob("serving_*.json")):
+        if path.name in ("serving_manifest.json", "serving_resume.json"):
+            continue
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(data, dict) and data.get("schema", "").startswith(
+                "dlbb_serving_report"):
+            report = data
+            break
+
+    marks: dict[str, dict[str, float]] = {}
+    for rec in session:
+        rid, ev = rec.get("config"), rec.get("event")
+        if not rid or not str(ev).startswith("request-"):
+            continue
+        m = marks.setdefault(rid, {})
+        m[ev[len("request-"):]] = float(rec["ts"])
+        if ev == "request-completed" and "output_tokens" in rec:
+            m["tokens"] = float(rec["output_tokens"])
+
+    entities: list[dict] = []
+    for rid in sorted(marks, key=lambda r: marks[r].get("arrived", 0.0)):
+        m = marks[rid]
+        row: dict[str, Any] = {"kind": "request", "name": rid}
+        arr = m.get("arrived")
+        adm = m.get("admitted")
+        pre = m.get("prefill")
+        end = next((m[k] for k in ("completed", "failed", "preempted",
+                                   "rejected", "infeasible") if k in m),
+                   None)
+        if arr is not None and adm is not None:
+            row["queue_wait_us"] = (adm - arr) * 1e6
+        elif arr is not None and "rejected" in m:
+            row["queue_wait_us"] = (m["rejected"] - arr) * 1e6
+        if adm is not None and pre is not None:
+            row["prefill_us"] = (pre - adm) * 1e6
+        if pre is not None and end is not None:
+            row["decode_us"] = (end - pre) * 1e6
+        if arr is not None and end is not None:
+            row["measured_us"] = (end - arr) * 1e6
+        if "tokens" in m:
+            row["tokens"] = int(m["tokens"])
+        row["outcome"] = next(
+            (k for k in ("completed", "failed", "preempted", "rejected",
+                         "infeasible") if k in m), "in-flight")
+        entities.append(row)
+
+    predicted: dict[str, float] = {}
+    if report:
+        feats = _serving_dispatch_features(report)
+        decode_units = float(report.get("decode_units",
+                                        report.get("decode_steps", 0)))
+        prefills = float(report.get("requests", {}).get("admitted", 0))
+        chunks = float(
+            (report.get("fast_path") or {}).get("prefill_chunks") or 0)
+        if chunks:
+            prefills = chunks
+        dec = predict_iteration_us(feats["decode"], tier)
+        pre = predict_iteration_us(feats["prefill"], tier)
+        predicted = {
+            "decode": dec["total"] * decode_units,
+            "prefill": pre["total"] * prefills,
+            "dispatch-overhead": (dec["dispatch"] * decode_units
+                                  + pre["dispatch"] * prefills),
+            "collective-wire": (dec["wire"] * decode_units
+                                + pre["wire"] * prefills),
+            "compute": (dec["compute"] * decode_units
+                        + pre["compute"] * prefills),
+            "decode_units": decode_units,
+            "prefill_dispatches": prefills,
+        }
+    return entities, predicted
+
+
+# ---------------------------------------------------------------------------
+# output (MD + CSV via atomic_write_text)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_us(us: Optional[float]) -> str:
+    if us is None or not math.isfinite(us):
+        return "-"
+    if us >= 1e6:
+        return f"{us / 1e6:.2f} s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f} ms"
+    return f"{us:.0f} us"
+
+
+def write_attribution(record: dict[str, Any],
+                      out_dir: "str | Path") -> tuple[Path, Path]:
+    import csv
+    import io
+
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    name = record["name"]
+    wall = record["wall_us"]
+    phases = record["phases_us"]
+    predicted = record["predicted_us"]
+
+    lines = [
+        f"# Time attribution — {name}",
+        "",
+        f"- schema: `{ATTRIBUTION_SCHEMA}`",
+        f"- kind: {record['kind']} (measured from {record['source']})",
+        f"- cost model: {record['cost_model_version']}"
+        + (f" (fit v{record['fit_version']})"
+           if record.get("fit_version") else "")
+        + f" / tier {record['tier']}",
+        f"- wall time: {_fmt_us(wall)}",
+        "",
+        "## Where the wall time went",
+        "",
+        "Measured phases partition the "
+        + ("main span track" if record["source"] == "span-trace"
+           else "journal event stream")
+        + " — they sum to the wall time.  Predicted columns decompose "
+          "the device-work phases with the "
+        + record["cost_model_version"]
+        + " model (γ·dispatches + α·collectives + wire/β + FLOPs/peak).",
+        "",
+        "| phase | measured | share | predicted |",
+        "|---|---:|---:|---:|",
+    ]
+    for phase in PHASES:
+        us = phases.get(phase)
+        if not us:
+            continue
+        share = us / wall * 100 if wall else 0.0
+        pred = predicted.get(phase)
+        lines.append(f"| {phase} | {_fmt_us(us)} | {share:.1f}% | "
+                     f"{_fmt_us(pred) if pred else '-'} |")
+    covered = sum(phases.values())
+    lines.append(f"| **total** | {_fmt_us(covered)} | "
+                 f"{covered / wall * 100 if wall else 0:.1f}% | |")
+    lines += [
+        "",
+        "## Predicted device-work decomposition",
+        "",
+        "| term | predicted |",
+        "|---|---:|",
+    ]
+    for term in ("dispatch-overhead", "collective-wire", "compute"):
+        if term in predicted:
+            lines.append(f"| {term} | {_fmt_us(predicted[term])} |")
+    ent_label = ("request" if record["kind"] == "serving" else "config")
+    measured_ents = [e for e in record["entities"]
+                     if e.get("measured_us") is not None]
+    top = sorted(measured_ents, key=lambda e: -e["measured_us"])[:20]
+    lines += [
+        "",
+        f"## Top {ent_label}s by measured time "
+        f"({len(record['entities'])} total; full table in the CSV)",
+        "",
+    ]
+    if record["kind"] == "serving":
+        lines += [
+            "| request | total | queue-wait | prefill | decode | tokens "
+            "| outcome |",
+            "|---|---:|---:|---:|---:|---:|---|",
+        ]
+        for e in top:
+            lines.append(
+                f"| {e['name']} | {_fmt_us(e.get('measured_us'))} | "
+                f"{_fmt_us(e.get('queue_wait_us'))} | "
+                f"{_fmt_us(e.get('prefill_us'))} | "
+                f"{_fmt_us(e.get('decode_us'))} | "
+                f"{e.get('tokens', '-')} | {e.get('outcome', '-')} |")
+    else:
+        lines += [
+            "| config | wall | execute (measured) | execute (predicted) "
+            "| of which dispatch | wire | compute | err |",
+            "|---|---:|---:|---:|---:|---:|---:|---:|",
+        ]
+        for e in top:
+            err = e.get("error_factor")
+            lines.append(
+                f"| {e['name']} | {_fmt_us(e.get('measured_us'))} | "
+                f"{_fmt_us(e.get('execute_us'))} | "
+                f"{_fmt_us(e.get('predicted_execute_us'))} | "
+                f"{_fmt_us(e.get('predicted_dispatch_overhead_us'))} | "
+                f"{_fmt_us(e.get('predicted_wire_us'))} | "
+                f"{_fmt_us(e.get('predicted_compute_us'))} | "
+                f"{f'{err:.2f}x' if err else '-'} |")
+    lines.append("")
+    md_path = atomic_write_text("\n".join(lines), out_dir / f"{name}.md")
+
+    buf = io.StringIO()
+    writer = csv.DictWriter(buf, fieldnames=list(CSV_COLUMNS),
+                            extrasaction="ignore")
+    writer.writeheader()
+    for e in record["entities"]:
+        writer.writerow(e)
+    csv_path = atomic_write_text(buf.getvalue(), out_dir / f"{name}.csv",
+                                 newline="")
+    return md_path, csv_path
+
+
+def validate_attribution(record: dict[str, Any],
+                         tolerance: float = 0.05) -> list[str]:
+    """Schema/consistency check (the acceptance contract): required
+    keys, known phases only, and the measured phase partition summing to
+    the wall time within ``tolerance``.  Returns problems (empty =
+    valid)."""
+    problems: list[str] = []
+    for key in ("schema", "name", "kind", "wall_us", "phases_us",
+                "entities", "cost_model_version"):
+        if key not in record:
+            problems.append(f"missing key {key!r}")
+    if record.get("schema") != ATTRIBUTION_SCHEMA:
+        problems.append(f"schema {record.get('schema')!r} != "
+                        f"{ATTRIBUTION_SCHEMA!r}")
+    unknown = set(record.get("phases_us", {})) - set(PHASES)
+    if unknown:
+        problems.append(f"unknown phase(s) {sorted(unknown)}")
+    wall = record.get("wall_us") or 0.0
+    covered = sum(record.get("phases_us", {}).values())
+    if wall <= 0:
+        # an empty partition must never validate — it means the input
+        # trace carried no measurable span time at all
+        problems.append("wall_us is zero — nothing was attributed")
+    elif abs(covered - wall) > tolerance * wall:
+        problems.append(
+            f"phases cover {covered:.0f}us of {wall:.0f}us wall "
+            f"({covered / wall * 100:.1f}%, tolerance {tolerance:.0%})"
+        )
+    return problems

@@ -41,7 +41,10 @@ import time
 from pathlib import Path
 from typing import Any, Optional, Sequence
 
-from dlbb_tpu.analysis.costmodel import COST_MODEL_VERSION
+from dlbb_tpu.analysis.costmodel import (
+    COST_MODEL_VERSION,
+    resolve_tier,
+)
 from dlbb_tpu.analysis.findings import (
     SEVERITY_ERROR,
     SEVERITY_WARNING,
@@ -58,6 +61,16 @@ DEFAULT_REPORT_DIR = Path("results/obs")
 BASELINE_NAME = "calibration_baseline.json"
 REPORT_NAME = "calibration_report.json"
 CSV_NAME = "calibration_report.csv"
+METRICS_NAME = "metrics.prom"
+
+
+def baseline_name(model: str = COST_MODEL_VERSION) -> str:
+    """Each cost model gets its own committed baseline file (cm1 keeps
+    the historical name): the error factors of different models are not
+    comparable, so the diff gate never joins across them."""
+    if model in (None, COST_MODEL_VERSION):
+        return BASELINE_NAME
+    return f"calibration_baseline_{model}.json"
 
 # diff-gate slacks: measured medians on a loaded CPU host wobble by
 # small factors run to run (a process-cold subset run measured ~3.5x
@@ -72,7 +85,8 @@ AGGREGATE_SLACK = 8.0   # geomean error factor across joined targets
 TARGET_SLACK = 16.0     # per-target factor (warning only)
 
 CSV_COLUMNS = (
-    "target", "tier", "cost_model_version", "predicted_us", "measured_us",
+    "target", "tier", "cost_model_version", "predicted_us",
+    "dispatch_count", "predicted_dispatch_overhead_us", "measured_us",
     "signed_rel_error", "error_factor", "reps",
 )
 
@@ -99,24 +113,39 @@ def measure_target(target: Any, warmup: int = 5,
     out = jitted(*args)
     jax.block_until_ready(out)  # absorbs compile
     cur_args = tuple(args)
-    donated = False
+    # carry protocols, probed in order: "head" feeds out[0] back as the
+    # next first argument (train steps returning (state, metrics)),
+    # "whole" feeds the entire output back (programs whose output IS the
+    # donated carry, e.g. the serving compaction scatter)
+    carry = None
     try:
         out = jitted(*cur_args)
         jax.block_until_ready(out)
     except Exception:  # noqa: BLE001 — donated-buffer probe
-        donated = True
-        cur_args = (out[0], *cur_args[1:])
-        out = jitted(*cur_args)
-        jax.block_until_ready(out)
-        cur_args = (out[0], *cur_args[1:])
+        probe_err: Optional[Exception] = None
+        for mode in ("head", "whole"):
+            try:
+                fed = out[0] if mode == "head" else out
+                trial = (fed, *cur_args[1:])
+                out = jitted(*trial)
+                jax.block_until_ready(out)
+                carry = mode
+                cur_args = ((out[0] if mode == "head" else out),
+                            *cur_args[1:])
+                break
+            except Exception as e:  # noqa: BLE001 — try the next protocol
+                probe_err = e
+        if carry is None:
+            raise probe_err
     samples: list[float] = []
     for i in range(max(0, warmup - 2) + reps):
         t0 = time.perf_counter()
         out = jitted(*cur_args)
         jax.block_until_ready(out)
         elapsed = time.perf_counter() - t0
-        if donated:
-            cur_args = (out[0], *cur_args[1:])
+        if carry is not None:
+            cur_args = ((out[0] if carry == "head" else out),
+                        *cur_args[1:])
         if i >= max(0, warmup - 2):
             samples.append(elapsed)
     samples.sort()
@@ -126,7 +155,8 @@ def measure_target(target: Any, warmup: int = 5,
         "measured_min_us": samples[0] * 1e6,
         "measured_p90_us": samples[min(n - 1, int(n * 0.9))] * 1e6,
         "reps": n,
-        "donated_carry": donated,
+        "donated_carry": carry is not None,
+        **({"carry_protocol": carry} if carry else {}),
     }
 
 
@@ -138,11 +168,21 @@ def run_calibration(
     warmup: int = 5,
     target_filter: Optional[Sequence[str]] = None,
     verbose: bool = True,
+    model: str = COST_MODEL_VERSION,
+    fit_dir: "Optional[str | Path]" = None,
 ) -> dict[str, Any]:
     """Measure every committed schedule-baseline target buildable on the
-    current mesh and join against its predicted critical path.  Returns
+    current mesh and join against its predicted wall time.  Returns
     (and writes) the calibration report; merges the aggregate into
-    ``out_dir/sweep_manifest.json``."""
+    ``out_dir/sweep_manifest.json``.
+
+    ``model`` selects the pricing: cm1 reads each committed baseline's
+    ``critical_path_us`` (γ = 0, the historical behaviour); cm2 resolves
+    the fitted tier (``stats/analysis/costmodel_fit/``) and re-prices
+    every target's schedule with the fitted α/β/peak plus the
+    per-dispatch γ — falling back to cm1 with a loud ``fit-missing``
+    warning when no DB is committed (the report records the model that
+    actually priced it)."""
     import jax
 
     from dlbb_tpu.analysis.hlo_audit import default_targets, default_tier
@@ -152,6 +192,7 @@ def run_calibration(
     baselines_dir = Path(baselines_dir or DEFAULT_BASELINE_DIR)
     out_dir = Path(out_dir or DEFAULT_REPORT_DIR)
     tier = tier or default_tier()
+    cost_tier = resolve_tier(tier, model=model, fit_dir=fit_dir)
     baselines = load_baselines(baselines_dir)
     if not baselines:
         raise FileNotFoundError(
@@ -188,11 +229,50 @@ def run_calibration(
                            f"{base.get('tier')!r}, measuring on {tier!r}"),
             })
             continue
-        predicted = base.get("critical_path_us")
-        if not predicted:
-            skipped.append({"target": name,
-                            "reason": "baseline has no critical_path_us"})
-            continue
+        overhead = cost_tier.gamma_dispatch_us
+        if cost_tier.version == COST_MODEL_VERSION:
+            cp = base.get("critical_path_us")
+            if not cp:
+                # cm1 prices this program at zero (no collectives, no
+                # dots — e.g. the serving compaction jits): nothing to
+                # compare, BUT its measured time is the purest
+                # per-dispatch-γ sample the fit corpus can get, so
+                # measure it and carry the number on the skip record
+                # (excluded from every aggregate)
+                entry = {
+                    "target": name,
+                    "reason": ("baseline has no critical_path_us "
+                               "(measured for the fit corpus only)"),
+                }
+                try:
+                    m = measure_target(target, warmup=warmup, reps=reps)
+                    entry["measured_us"] = m["measured_us"]
+                    entry["reps"] = m["reps"]
+                except Exception as e:  # noqa: BLE001 — containment
+                    entry["reason"] += (f"; measurement crashed: "
+                                        f"{type(e).__name__}: {e}")
+                skipped.append(entry)
+                continue
+            predicted = float(cp) + overhead  # γ = 0 under cm1
+        else:
+            # fitted model: re-price this target's schedule with the
+            # fitted tier (the committed baselines are cm1-priced, so
+            # their numbers cannot serve a cm2 prediction)
+            from dlbb_tpu.analysis.hlo_audit import audit_target
+
+            try:
+                _f, meta = audit_target(target, passes=("schedule",),
+                                        tier=cost_tier)
+                predicted = float(meta["schedule"]["predicted_wall_us"])
+            except Exception as e:  # noqa: BLE001 — per-target containment
+                skipped.append({
+                    "target": name,
+                    "reason": (f"cm2 re-pricing crashed: "
+                               f"{type(e).__name__}: {e}"),
+                })
+                if verbose:
+                    print(f"[obs] {name}: CRASH ({type(e).__name__}: {e})")
+                continue
         try:
             with spans.span(f"calibrate:{name}", cat="calibration"):
                 measured = measure_target(target, warmup=warmup, reps=reps)
@@ -208,9 +288,11 @@ def run_calibration(
         row = {
             "target": name,
             "tier": tier,
-            "cost_model_version": base.get("cost_model_version"),
+            "cost_model_version": cost_tier.version,
             "predicted_us": float(predicted),
-            "signed_rel_error": (m_us - predicted) / predicted,
+            "dispatch_count": 1,
+            "predicted_dispatch_overhead_us": overhead,
+            "signed_rel_error": (m_us - predicted) / max(predicted, 1e-9),
             "error_factor": _error_factor(m_us, predicted),
             **measured,
         }
@@ -223,13 +305,19 @@ def run_calibration(
     report = {
         "schema": CALIBRATION_SCHEMA,
         "tier": tier,
-        "cost_model_version": COST_MODEL_VERSION,
+        "cost_model_version": cost_tier.version,
         "baselines_dir": str(baselines_dir),
         "aggregate": aggregate_errors(rows, skipped),
         "targets": rows,
         "skipped": skipped,
         "timestamp": time.time(),
     }
+    if cost_tier.fit is not None:
+        report["fit"] = {
+            k: cost_tier.fit.get(k)
+            for k in ("fit_version", "db_path", "samples_used",
+                      "residuals")
+        }
     write_report(report, out_dir)
     return report
 
@@ -300,49 +388,163 @@ def write_report(report: dict[str, Any], out_dir: Path) -> Path:
         "cost_model_version": report["cost_model_version"],
         **report["aggregate"],
     }
+    if "fit" in report:
+        # the fitted-DB version this calibration was priced with — the
+        # manifest-side record the fit_smoke CI stage pins
+        manifest["calibration"]["fit_version"] = report["fit"].get(
+            "fit_version")
+        manifest["calibration"]["fitted_db"] = report["fit"].get("db_path")
     manifest.setdefault("timestamp", time.time())
     save_json(manifest, manifest_path)
+    _fold_metrics(calibration_metrics(report), out_dir / METRICS_NAME)
     return path
+
+
+def _metric_family(line: str) -> Optional[str]:
+    if line.startswith("# HELP ") or line.startswith("# TYPE "):
+        parts = line.split()
+        return parts[2] if len(parts) > 2 else None
+    if not line or line.startswith("#"):
+        return None
+    return line.split("{", 1)[0].split(" ", 1)[0]
+
+
+def _fold_metrics(registry, path: Path) -> Path:
+    """Fold the calibration gauges into an existing ``metrics.prom`` —
+    calibrating into a sweep/serving output directory must not clobber
+    that run's own export (every ``sweep_*``/``serve_*`` series would
+    vanish from the scrape target, while the manifest path carefully
+    merges).  Existing lines of families the calibration does not own
+    are kept verbatim; re-runs replace only their own families."""
+    from dlbb_tpu.obs.export import PROM_PREFIX
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    own = {PROM_PREFIX + name for name in registry.as_dict()}
+    kept: list[str] = []
+    try:
+        for line in Path(path).read_text().splitlines():
+            fam = _metric_family(line)
+            if fam is None or fam not in own:
+                kept.append(line)
+    except OSError:
+        pass
+    text = ("\n".join(kept) + "\n" if kept else "") \
+        + registry.to_prometheus()
+    return atomic_write_text(text, Path(path))
+
+
+def calibration_metrics(report: dict[str, Any], registry=None):
+    """Calibration / fit health as Prometheus gauges
+    (``metrics.prom`` next to every calibration report): a drifting cost
+    model shows up on a scrape dashboard, not only in ``obs diff`` CI."""
+    from dlbb_tpu.obs.export import MetricsRegistry
+
+    registry = registry or MetricsRegistry()
+    labels = {"tier": report.get("tier"),
+              "model": report.get("cost_model_version")}
+    agg = report.get("aggregate", {})
+    for key, metric, hlp in (
+        ("geomean_error_factor", "obs_calibration_error_factor",
+         "geomean predicted-vs-measured error factor across targets"),
+        ("max_error_factor", "obs_calibration_max_error_factor",
+         "worst per-target error factor"),
+        ("median_signed_rel_error",
+         "obs_calibration_median_signed_rel_error",
+         "median signed relative error (bias direction)"),
+    ):
+        if agg.get(key) is not None:
+            registry.set_gauge(metric, agg[key], help=hlp, **labels)
+    registry.set_gauge("obs_calibration_targets",
+                       agg.get("targets_measured", 0),
+                       help="targets measured this calibration",
+                       outcome="measured", **labels)
+    registry.set_gauge("obs_calibration_targets",
+                       agg.get("targets_skipped", 0),
+                       outcome="skipped", **labels)
+    fit = report.get("fit")
+    if fit:
+        registry.set_gauge("obs_fit_version", fit.get("fit_version") or 0,
+                           help="fitted-DB version this run priced with",
+                           **labels)
+        if fit.get("samples_used") is not None:
+            registry.set_gauge("obs_fit_samples", fit["samples_used"],
+                               help="corpus samples the fit kept",
+                               **labels)
+        res = fit.get("residuals") or {}
+        for key, metric, hlp in (
+            ("geomean_error_factor", "obs_fit_residual_error_factor",
+             "geomean fit residual factor over the corpus"),
+            ("rms_log_error", "obs_fit_rms_log_error",
+             "rms log-space fit residual"),
+        ):
+            if res.get(key) is not None:
+                registry.set_gauge(metric, res[key], help=hlp, **labels)
+    return registry
 
 
 def save_calibration_baseline(report: dict[str, Any],
                               directory: Optional[Path] = None) -> Path:
-    """Commit a calibration report as the diff gate's reference point."""
+    """Commit a calibration report as the diff gate's reference point —
+    one file per cost model (``calibration_baseline.json`` for cm1,
+    ``calibration_baseline_cm2.json`` for cm2)."""
     from dlbb_tpu.utils.config import atomic_write_text
 
     directory = Path(directory or DEFAULT_CALIBRATION_DIR)
     directory.mkdir(parents=True, exist_ok=True)
-    path = directory / BASELINE_NAME
+    path = directory / baseline_name(report.get("cost_model_version"))
     atomic_write_text(
         json.dumps(report, indent=2, sort_keys=True) + "\n", path
     )
     return path
 
 
-def load_calibration_baseline(directory: "Path | str") -> dict[str, Any]:
+def load_calibration_baseline(directory: "Path | str",
+                              model: str = COST_MODEL_VERSION
+                              ) -> dict[str, Any]:
     directory = Path(directory)
-    path = directory / BASELINE_NAME if directory.is_dir() else directory
+    path = (directory / baseline_name(model) if directory.is_dir()
+            else directory)
     return json.loads(path.read_text())
 
 
 def diff_calibration(report: dict[str, Any],
-                     baseline_dir: "Path | str") -> list[Finding]:
+                     baseline_dir: "Path | str",
+                     requested_model: Optional[str] = None
+                     ) -> list[Finding]:
     """Findings when the fresh calibration regresses past the committed
     baseline.  The CI-gating (error) rules: no/unreadable baseline,
-    cost-model version or tier skew, and the joined-aggregate geomean
-    error factor growing more than :data:`AGGREGATE_SLACK`.  Per-target
-    drift and improvements warn."""
+    cost-model version or tier skew, the report having been priced with
+    a DIFFERENT model than ``requested_model`` (the cm2 fit DB fell back
+    to cm1 — gating cm1 against its own baseline would silently pass the
+    cm2 gate), and the joined-aggregate geomean error factor growing
+    more than :data:`AGGREGATE_SLACK`.  Per-target drift and
+    improvements warn."""
     findings: list[Finding] = []
+    model = report.get("cost_model_version", COST_MODEL_VERSION)
+    if requested_model and requested_model != model:
+        findings.append(Finding(
+            pass_name="obs", rule="cost-model-mismatch",
+            severity=SEVERITY_ERROR, target=str(baseline_dir),
+            message=(
+                f"--model {requested_model} was requested but the "
+                f"calibration was priced with {model} (missing fitted "
+                "DB? run `python -m dlbb_tpu.cli obs fit` and commit "
+                f"stats/analysis/costmodel_fit/) — refusing to gate "
+                f"{model} in its place"
+            ),
+        ))
+        return findings
     try:
-        base = load_calibration_baseline(baseline_dir)
+        base = load_calibration_baseline(baseline_dir, model=model)
     except (OSError, json.JSONDecodeError) as e:
         findings.append(Finding(
             pass_name="obs", rule="missing-calibration-baseline",
             severity=SEVERITY_ERROR, target=str(baseline_dir),
             message=(
-                f"no committed calibration baseline ({e}) — run "
-                "`python -m dlbb_tpu.cli obs calibrate --simulate 8` and "
-                f"commit {Path(baseline_dir) / BASELINE_NAME}"
+                f"no committed {model} calibration baseline ({e}) — run "
+                f"`python -m dlbb_tpu.cli obs calibrate --model {model} "
+                "--simulate 8` and commit "
+                f"{Path(baseline_dir) / baseline_name(model)}"
             ),
         ))
         return findings

@@ -288,9 +288,38 @@ def load_trace(path: "str | Path") -> dict[str, Any]:
     return json.loads(Path(path).read_text())
 
 
+# the two journal event streams a directory can hold (a sweep and a
+# serving run may share an output dir — and one append-only journal
+# file): each gets its own Perfetto track group (pid + process_name)
+_SWEEP_PID, _SERVE_PID = 1, 2
+
+
+def _classify_stream(records: list[dict[str, Any]]) -> list[int]:
+    """Per-record stream id: serving events (request lifecycle, and any
+    event inside a ``mode: serve`` session) go to the serving track
+    group, everything else to the sweep one.  Session markers
+    (``sweep-start``) switch the ambient mode for the events that
+    follow them in file order — both streams interleaved in ONE
+    append-only journal split cleanly, instead of the whole file being
+    rendered as whichever kind came first."""
+    pids: list[int] = []
+    ambient = _SWEEP_PID
+    for rec in records:
+        ev = str(rec.get("event", ""))
+        if ev == "sweep-start":
+            ambient = (_SERVE_PID if rec.get("mode") == "serve"
+                       else _SWEEP_PID)
+            pids.append(ambient)
+        elif ev.startswith("request-") or ev.startswith("serve"):
+            pids.append(_SERVE_PID)
+        else:
+            pids.append(ambient)
+    return pids
+
+
 def journal_to_trace(journal_dir: "str | Path",
                      out_path: "str | Path") -> tuple[Path, int, int]:
-    """Reconstruct a sweep timeline from ``sweep_journal.jsonl`` alone
+    """Reconstruct a run timeline from the fsync'd journal(s) alone
     (``cli obs trace``): every journal event becomes a trace instant, and
     each config's ``started`` -> ``completed``/``failed`` pair becomes a
     complete ("X") span — so even a sweep that crashed before writing its
@@ -301,51 +330,85 @@ def journal_to_trace(journal_dir: "str | Path",
     end-to-end span (queueing included) — failed and preempted
     lifecycles stay debuggable from the journal alone, exactly as
     completed ones do.
+
+    A directory holding BOTH a sweep and a serving event stream —
+    interleaved in the append-only ``sweep_journal.jsonl``, or split
+    across ``*journal*.jsonl`` files — yields ONE merged timeline with
+    two labelled track groups (``sweep`` / ``serving``), config and
+    request spans each pairing within their own stream.
     Returns ``(path, events_converted, torn_lines)``."""
-    from dlbb_tpu.resilience.journal import read_journal
+    from dlbb_tpu.resilience.journal import read_journal_file
     from dlbb_tpu.utils.config import atomic_write_text
 
-    records, torn = read_journal(journal_dir)
+    journal_dir = Path(journal_dir)
+    records: list[dict[str, Any]] = []
+    torn = 0
+    sources: list[str] = []
+    if journal_dir.is_dir():
+        files = sorted(journal_dir.glob("*journal*.jsonl"))
+    else:
+        files = [journal_dir]
+    for path in files:
+        recs, t = read_journal_file(path)
+        if recs:
+            records.extend(recs)
+            sources.append(path.name)
+        torn += t
     if not records:
         raise FileNotFoundError(
             f"no parseable journal events under {journal_dir} "
             "(is this a sweep output directory?)"
         )
+    pids = _classify_stream(records)
+    order = sorted(range(len(records)),
+                   key=lambda i: float(records[i].get("ts", 0.0)))
     t0 = min(float(r["ts"]) for r in records if "ts" in r)
     events: list[dict[str, Any]] = []
-    open_configs: dict[str, float] = {}
-    for rec in records:
+    seen_pids = sorted(set(pids))
+    for pid in seen_pids:
+        events.append({
+            "name": "process_name", "ph": "M", "ts": 0.0,
+            "pid": pid, "tid": 0,
+            "args": {"name": ("serving" if pid == _SERVE_PID
+                              else "sweep")},
+        })
+    open_configs: dict[tuple[int, str], float] = {}
+    for i in order:
+        rec, pid = records[i], pids[i]
         ts_us = (float(rec.get("ts", t0)) - t0) * 1e6
         name = rec.get("event", "?")
         config = rec.get("config")
         args = {k: v for k, v in rec.items() if k != "ts"}
         if name in ("started", "request-arrived") and config:
-            open_configs[config] = ts_us
+            open_configs[(pid, config)] = ts_us
         elif (name in ("completed", "failed", "request-completed",
                        "request-rejected", "request-infeasible",
                        "request-failed", "request-preempted")
-              and config in open_configs):
-            start_us = open_configs.pop(config)
+              and (pid, config) in open_configs):
+            start_us = open_configs.pop((pid, config))
             kind = name[len("request-"):] if name.startswith(
                 "request-") else name
             events.append({
                 "name": config, "cat": f"config-{kind}", "ph": "X",
                 "ts": start_us, "dur": max(ts_us - start_us, 0.0),
-                "pid": 1, "tid": 1, "args": _jsonable(args),
+                "pid": pid, "tid": 1, "args": _jsonable(args),
             })
         events.append({
             "name": name, "cat": "journal", "ph": "i", "s": "t",
-            "ts": ts_us, "pid": 1, "tid": 1, "args": _jsonable(args),
+            "ts": ts_us, "pid": pid, "tid": 1, "args": _jsonable(args),
         })
     payload = {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "otherData": {
             "schema": SPAN_SCHEMA,
-            "source": "sweep_journal.jsonl",
+            "source": ",".join(sources),
             "journal_dir": str(journal_dir),
             "wall_t0": t0,
             "torn_lines": torn,
+            "streams": {str(pid): ("serving" if pid == _SERVE_PID
+                                   else "sweep")
+                        for pid in seen_pids},
         },
     }
     path = atomic_write_text(json.dumps(payload), Path(out_path))

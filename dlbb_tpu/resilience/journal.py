@@ -135,25 +135,34 @@ def read_journal(out_dir: "str | Path") -> tuple[list[dict], int]:
     anywhere else is counted the same way (it can only mean a crashed
     writer, and every parseable event remains trustworthy because each
     was fsync'd before the next was attempted)."""
-    path = Path(out_dir) / JOURNAL_NAME
+    return read_journal_file(Path(out_dir) / JOURNAL_NAME)
+
+
+def read_journal_file(path: "str | Path") -> tuple[list[dict], int]:
+    """Parse one journal JSONL file (torn-line semantics of
+    :func:`read_journal`; a missing/unreadable file is an empty
+    journal, not an error — obs reads non-canonical ``*journal*.jsonl``
+    names through this too)."""
     events: list[dict] = []
     torn = 0
-    if not path.exists():
+    try:
+        with open(path) as f:
+            lines = list(f)
+    except OSError:
         return events, torn
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                torn += 1
-                continue
-            if isinstance(rec, dict):
-                events.append(rec)
-            else:
-                torn += 1
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            torn += 1
+            continue
+        if isinstance(rec, dict):
+            events.append(rec)
+        else:
+            torn += 1
     return events, torn
 
 

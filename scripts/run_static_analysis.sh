@@ -51,6 +51,29 @@ JAX_PLATFORMS=cpu python -m dlbb_tpu.cli obs diff --simulate 8 \
     --reps 15 --warmup 5
 rm -rf "$OBS_TMP"
 
+# fit_smoke (docs/observability.md, "Fitting & attribution"): the cm2
+# loop — (1) the fit pipeline proves out on the committed mini corpus
+# (results/fit_corpus) into a THROWAWAY DB: seeded-coefficient recovery
+# + degenerate-corpus refusal run in the pytest marker; (2) `obs
+# calibrate --model cm2` prices a micro-op subset from the COMMITTED
+# fitted DB (stats/analysis/costmodel_fit/) and `obs diff --model cm2`
+# gates the joined-subset geomean against the committed cm2 calibration
+# baseline (stats/analysis/calibration/calibration_baseline_cm2.json);
+# (3) the calibrate run's sweep_manifest.json must record the fitted-DB
+# version it priced with.
+JAX_PLATFORMS=cpu python -m pytest tests/test_costmodel_fit.py -q \
+    -m fit_smoke -p no:cacheprovider
+FIT_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli obs fit \
+    --results results/fit_corpus --tier cpu-sim --fit-dir "$FIT_TMP/db"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli obs diff --model cm2 --simulate 8 \
+    --output "$FIT_TMP/cal" --targets "::allgather" "::alltoall" \
+    "::barrier" --reps 15 --warmup 5
+grep -q '"fit_version"' "$FIT_TMP/cal/sweep_manifest.json" \
+    || { echo "fit_smoke: calibrate manifest lost the fitted-DB version"; \
+         exit 1; }
+rm -rf "$FIT_TMP"
+
 # compile-ahead sweep-engine smoke (bench/schedule.py is covered by the
 # lint pass above; this exercises the pipelined path end-to-end on the
 # simulated mesh — 2-op mini-sweep, compile accounting, manifest)

@@ -72,6 +72,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fit_smoke: cost-model fit smoke — cm2 regression on a mini "
+        "corpus recovers seeded coefficients, the fitted DB round-trips "
+        "through calibrate/diff, degenerate corpora fail closed "
+        "(tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "serve_smoke: serving-engine smoke — a seeded 30-request Poisson "
         "mini-trace through the continuous-batching engine with span "
         "trace + journal + metrics export (tier-1; also invoked "
