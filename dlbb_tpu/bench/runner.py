@@ -1098,6 +1098,14 @@ def _run_one(
             metrics.inc("sweep_device_captures",
                         help="dedicated profile reps captured "
                              "(excluded from stats)")
+        elif metrics is not None:
+            # a contained failure is invisible in the stats series by
+            # design — the labelled counter (folded into metrics.prom)
+            # is where a fleet notices its captures silently dying
+            metrics.inc("obs_device_capture_failures",
+                        reason=capture_meta.get("error_kind", "unknown"),
+                        help="contained device-capture failures "
+                             "(error recorded in the result JSON)")
 
     # the first config that WRITES an artifact reports the compile its
     # work unit paid for (see WorkUnit.compile_reported); later sharers

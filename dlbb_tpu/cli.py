@@ -198,7 +198,7 @@ def build_parser() -> argparse.ArgumentParser:
              "(docs/observability.md)",
     )
     ob.add_argument("which", choices=("trace", "calibrate", "diff",
-                                      "fit", "attribute"),
+                                      "fit", "attribute", "devtrace"),
                     help="trace = rebuild a Perfetto timeline from a "
                          "sweep's journal; calibrate = measure every "
                          "committed schedule-baseline target and report "
@@ -210,7 +210,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "fitted DB; attribute = join a run's span "
                          "trace/journal against the cost model into a "
                          "per-phase 'where did the time go' report "
-                         "(MD+CSV under stats/analysis/attribution/)")
+                         "(MD+CSV under stats/analysis/attribution/); "
+                         "devtrace = parse the run's device captures "
+                         "into per-op measured timelines, report "
+                         "measured overlap beside the static proof, and "
+                         "mine the op-level cm2 fit samples (MD+CSV+JSON "
+                         "under stats/analysis/devtrace/)")
     ob.add_argument("--journal", default=None, metavar="DIR",
                     help="sweep output directory holding "
                          "sweep_journal.jsonl (obs trace)")
@@ -378,6 +383,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "trace-event JSON) of the run to FILE; "
                          "DLBB_SPANS env is the default "
                          "(docs/observability.md)")
+    sv.add_argument("--device-trace", default=None, metavar="DIR",
+                    dest="device_trace",
+                    help="capture one prefill + one decode scan through "
+                         "the obs/capture gate AFTER the trace is served "
+                         "(outside every timed region) under DIR; "
+                         "DLBB_DEVICE_TRACE env is the default; parsed "
+                         "by `obs devtrace` (docs/observability.md)")
 
     tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
@@ -752,6 +764,7 @@ def _dispatch(args) -> int:
             resume=args.resume,
             fault_plan=args.fault_plan,
             slo=args.slo,
+            device_trace=args.device_trace,
         )
         req = result["requests"]
         if result.get("preempted"):

@@ -38,8 +38,16 @@ the loop:
   into phases and joined against the fitted model's
   dispatch-overhead / wire / compute decomposition, per config and per
   serving request (MD + CSV under ``stats/analysis/attribution/``).
+- :mod:`~dlbb_tpu.obs.devtrace` — device-trace analysis
+  (``cli obs devtrace``): the per-config capture's trace-event JSON
+  parsed into a per-op measured timeline, bucketed by op kind, joined
+  against the static schedule baselines (measured overlap efficiency
+  beside the static proof, ``runtime-serialized-collective`` gate) and
+  mined for the op-level β fit samples (MD + CSV + JSON under
+  ``stats/analysis/devtrace/``).
 
-CLI: ``python -m dlbb_tpu.cli obs {trace,calibrate,diff,fit,attribute}``.
+CLI: ``python -m dlbb_tpu.cli obs
+{trace,calibrate,diff,fit,attribute,devtrace}``.
 Exit codes follow the pinned ``analysis.findings.EXIT_*`` contract:
 0 clean / 1 findings / 2 crash.  See ``docs/observability.md``.
 """
@@ -153,6 +161,23 @@ def _run_obs(
             print(f"[obs] fit refused: {e}")
             return EXIT_FINDINGS
         return EXIT_CLEAN
+
+    if which == "devtrace":
+        from dlbb_tpu.obs.devtrace import run_devtrace
+
+        if not journal:
+            print("error: obs devtrace needs --journal DIR (a sweep or "
+                  "serving output directory whose artifacts record "
+                  "device captures)")
+            return EXIT_CRASH
+        _report, findings = run_devtrace(
+            input_dir=journal, out_dir=output, baselines_dir=baselines,
+            verbose=verbose,
+        )
+        result = AnalysisReport(findings=findings)
+        if findings and verbose:
+            print(result.render_summary())
+        return result.exit_code(strict_warnings=strict_warnings)
 
     if which == "attribute":
         from dlbb_tpu.obs.attribution import (

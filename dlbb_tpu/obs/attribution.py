@@ -42,7 +42,6 @@ dispatch counts are exact.
 from __future__ import annotations
 
 import json
-import math
 from pathlib import Path
 from typing import Any, Optional
 
@@ -51,6 +50,7 @@ from dlbb_tpu.analysis.costmodel import (
     CostTier,
     resolve_tier,
 )
+from dlbb_tpu.obs.devtrace import _fmt_us
 
 ATTRIBUTION_SCHEMA = "dlbb_attribution_v1"
 DEFAULT_ATTRIBUTION_DIR = Path("stats/analysis/attribution")
@@ -95,11 +95,41 @@ _JOURNAL_PHASE = {
 
 CSV_COLUMNS = (
     "kind", "name", "measured_us", "queue_wait_us", "prefill_us",
-    "decode_us", "compile_us", "execute_us", "predicted_execute_us",
+    "decode_us", "compile_us", "execute_us", "device_us",
+    "predicted_execute_us",
     "predicted_dispatch_overhead_us", "predicted_wire_us",
     "predicted_compute_us", "dispatches", "iterations", "tokens",
     "error_factor", "outcome",
 )
+
+
+def _capture_device_us(meta: dict[str, Any],
+                       input_dir: Path) -> Optional[float]:
+    """Device-measured busy time of ONE execution from a config's
+    gated capture (``obs/devtrace.py``): each device's summed device-op
+    event time, median across devices, amortised per profile rep.
+    None when the capture is absent, failed, or unparseable — the
+    device column stays honest-blank rather than guessed."""
+    from dlbb_tpu.obs.devtrace import (
+        CaptureError,
+        _resolve_capture_path,
+        device_comm_samples,
+        parse_capture,
+    )
+
+    if not isinstance(meta, dict) or "error" in meta:
+        return None
+    path = _resolve_capture_path(meta, input_dir)
+    if path is None:
+        return None
+    try:
+        timeline = parse_capture(path)
+    except CaptureError:
+        return None
+    agg = device_comm_samples(timeline,
+                              int(meta.get("profile_reps", 1)),
+                              buckets=None)
+    return agg["measured_device_us"] if agg else None
 
 
 def _infer_tier(input_dir: Path) -> str:
@@ -362,11 +392,11 @@ def run_attribution(
     serving = any(str(r.get("event", "")).startswith("request-")
                   for r in session)
     if serving:
-        entities, predicted = _serving_entities(input_dir, session,
-                                                cost_tier)
+        entities, predicted, device_us = _serving_entities(
+            input_dir, session, cost_tier)
     else:
-        entities, predicted = _sweep_entities(input_dir, session,
-                                              cost_tier)
+        entities, predicted, device_us = _sweep_entities(
+            input_dir, session, cost_tier)
 
     record = {
         "schema": ATTRIBUTION_SCHEMA,
@@ -381,6 +411,10 @@ def run_attribution(
         "phases_us": {p: phase_us.get(p, 0.0) for p in PHASES
                       if phase_us.get(p)},
         "predicted_us": predicted,
+        # device-measured phase totals from the run's gated captures
+        # (one captured execution x the recorded execution count);
+        # empty when the run was uncaptured
+        "device_us": device_us,
         "entities": entities,
         "torn_journal_lines": torn,
     }
@@ -415,6 +449,7 @@ def _sweep_entities(input_dir: Path, session: list[dict],
     entities: list[dict] = []
     pred_totals = {"dispatch": 0.0, "wire": 0.0, "compute": 0.0,
                    "total": 0.0}
+    device_execute = 0.0
     configs = sorted(set(started) | set(done)) or sorted(
         p.name for p in input_dir.glob("*.json")
         if p.name != "sweep_manifest.json"
@@ -426,6 +461,7 @@ def _sweep_entities(input_dir: Path, session: list[dict],
             row["measured_us"] = (done[cfg][0] - started[cfg]) * 1e6
             row["outcome"] = done[cfg][1]
         sample = None
+        data = None
         if path.exists():
             try:
                 data = json.loads(path.read_text())
@@ -435,6 +471,15 @@ def _sweep_entities(input_dir: Path, session: list[dict],
                         data.get("compile_seconds", 0.0)) * 1e6
             except (OSError, json.JSONDecodeError):
                 pass
+        if isinstance(data, dict):
+            # the device column: one captured execution's device-op
+            # busy time (median across devices), measured by the gated
+            # capture — side by side with the host-span numbers
+            dev = _capture_device_us(data.get("device_trace"), input_dir)
+            if dev is not None:
+                row["device_us"] = dev
+                if sample is not None:
+                    device_execute += dev * sample["iterations"]
         if sample is not None:
             iters = sample["iterations"]
             per_iter = predict_iteration_us(sample, tier)
@@ -456,19 +501,27 @@ def _sweep_entities(input_dir: Path, session: list[dict],
                           ("total", "predicted_execute_us")):
                 pred_totals[k] += row[kk]
         entities.append(row)
-    return entities, {
+    predicted = {
         "execute": pred_totals["total"],
         "dispatch-overhead": pred_totals["dispatch"],
         "collective-wire": pred_totals["wire"],
         "compute": pred_totals["compute"],
     }
+    # device-measured execute: one captured execution's device busy
+    # time x the iteration count each config timed (empty when the run
+    # carried no captures — the column stays honest-blank)
+    device_us = {"execute": device_execute} if device_execute > 0 else {}
+    return entities, predicted, device_us
 
 
 def _serving_entities(input_dir: Path, session: list[dict],
-                      tier: CostTier) -> tuple[list[dict], dict]:
+                      tier: CostTier
+                      ) -> tuple[list[dict], dict, dict]:
     """Per-request measured rows (queue-wait / prefill / decode from the
     journal lifecycle) + phase-level predictions from the run report's
-    exact dispatch counts."""
+    exact dispatch counts + device-measured phase totals from the run's
+    capture metas (one captured dispatch per phase x the dispatch
+    count)."""
     report: dict[str, Any] = {}
     for path in sorted(input_dir.glob("serving_*.json")):
         if path.name in ("serving_manifest.json", "serving_resume.json"):
@@ -520,6 +573,7 @@ def _serving_entities(input_dir: Path, session: list[dict],
         entities.append(row)
 
     predicted: dict[str, float] = {}
+    device_us: dict[str, float] = {}
     if report:
         feats = _serving_dispatch_features(report)
         decode_units = float(report.get("decode_units",
@@ -543,22 +597,30 @@ def _serving_entities(input_dir: Path, session: list[dict],
             "decode_units": decode_units,
             "prefill_dispatches": prefills,
         }
-    return entities, predicted
+        # the device column: each phase's captured per-dispatch device
+        # busy time x the same dispatch counts the predictions price
+        for meta in (report.get("observability") or {}).get(
+                "device_captures", ()):
+            dev = _capture_device_us(meta, input_dir)
+            if dev is None:
+                continue
+            phase = meta.get("phase")
+            if phase == "prefill" and prefills:
+                device_us["prefill"] = dev * prefills
+            elif phase == "decode" and decode_units:
+                # the captured scan ran a fixed k token steps while the
+                # run's scans vary k per dispatch — normalise the
+                # captured time per STEP and scale by the run's total
+                # decode steps, never by dispatch count
+                k_cap = max(1, int(meta.get("decode_steps_per_scan", 1)))
+                steps = float(report.get("decode_steps", decode_units))
+                device_us["decode"] = dev / k_cap * steps
+    return entities, predicted, device_us
 
 
 # ---------------------------------------------------------------------------
 # output (MD + CSV via atomic_write_text)
 # ---------------------------------------------------------------------------
-
-
-def _fmt_us(us: Optional[float]) -> str:
-    if us is None or not math.isfinite(us):
-        return "-"
-    if us >= 1e6:
-        return f"{us / 1e6:.2f} s"
-    if us >= 1e3:
-        return f"{us / 1e3:.1f} ms"
-    return f"{us:.0f} us"
 
 
 def write_attribution(record: dict[str, Any],
@@ -594,22 +656,29 @@ def write_attribution(record: dict[str, Any],
         + " — they sum to the wall time.  Predicted columns decompose "
           "the device-work phases with the "
         + record["cost_model_version"]
-        + " model (γ·dispatches + α·collectives + wire/β + FLOPs/peak).",
+        + " model (γ·dispatches + α·collectives + wire/β + FLOPs/peak)."
+        + ("  The device column is measured from the run's gated "
+           "captures: one captured execution's device-op busy time x "
+           "the recorded execution count (obs devtrace parses the "
+           "same captures per op)." if record.get("device_us") else ""),
         "",
-        "| phase | measured | share | predicted |",
-        "|---|---:|---:|---:|",
+        "| phase | measured | share | device (captured) | predicted |",
+        "|---|---:|---:|---:|---:|",
     ]
+    device_us = record.get("device_us") or {}
     for phase in PHASES:
         us = phases.get(phase)
         if not us:
             continue
         share = us / wall * 100 if wall else 0.0
         pred = predicted.get(phase)
+        dev = device_us.get(phase)
         lines.append(f"| {phase} | {_fmt_us(us)} | {share:.1f}% | "
+                     f"{_fmt_us(dev) if dev else '-'} | "
                      f"{_fmt_us(pred) if pred else '-'} |")
     covered = sum(phases.values())
     lines.append(f"| **total** | {_fmt_us(covered)} | "
-                 f"{covered / wall * 100 if wall else 0:.1f}% | |")
+                 f"{covered / wall * 100 if wall else 0:.1f}% | | |")
     lines += [
         "",
         "## Predicted device-work decomposition",
@@ -645,15 +714,17 @@ def write_attribution(record: dict[str, Any],
                 f"{e.get('tokens', '-')} | {e.get('outcome', '-')} |")
     else:
         lines += [
-            "| config | wall | execute (measured) | execute (predicted) "
+            "| config | wall | execute (measured) | device (1 rep) "
+            "| execute (predicted) "
             "| of which dispatch | wire | compute | err |",
-            "|---|---:|---:|---:|---:|---:|---:|---:|",
+            "|---|---:|---:|---:|---:|---:|---:|---:|---:|",
         ]
         for e in top:
             err = e.get("error_factor")
             lines.append(
                 f"| {e['name']} | {_fmt_us(e.get('measured_us'))} | "
                 f"{_fmt_us(e.get('execute_us'))} | "
+                f"{_fmt_us(e.get('device_us'))} | "
                 f"{_fmt_us(e.get('predicted_execute_us'))} | "
                 f"{_fmt_us(e.get('predicted_dispatch_overhead_us'))} | "
                 f"{_fmt_us(e.get('predicted_wire_us'))} | "

@@ -1,4 +1,4 @@
-"""Gated per-config device-trace capture (``jax.profiler`` xplane).
+"""Gated per-config device-trace capture (``jax.profiler``).
 
 The capture contract that keeps published numbers honest:
 
@@ -19,9 +19,20 @@ The capture contract that keeps published numbers honest:
   by accident.  This file is the sanctioned capture API and is exempt
   (like ``utils/timing.py`` for host syncs).
 
+Every capture is written as a PARSEABLE artifact: ``jax.profiler.trace``
+runs with ``create_perfetto_trace=True``, so the capture directory holds
+a trace-event JSON (``perfetto_trace.json.gz`` — the input of
+``dlbb_tpu.obs.devtrace``) next to the raw ``.xplane.pb`` files (kept
+for external profilers).  The metadata records the parseable trace path,
+the capture's wall seconds and its on-disk byte size, so the sweep
+manifest / devtrace report can account for capture cost.
+
 Capture failures are contained: a broken profiler (e.g. an outer
 ``--trace`` session already holding the singleton profiler state) lands
-as an ``error`` field in the capture metadata, never as a failed config.
+as an ``error`` field in the capture metadata, never as a failed config
+— and the sweep driver counts it in the
+``obs_device_capture_failures_total`` labelled counter exported to
+``metrics.prom``.
 """
 
 from __future__ import annotations
@@ -47,6 +58,10 @@ def _slug(label: str) -> str:
     return re.sub(r"[^\w.+-]+", "_", label).strip("_") or "capture"
 
 
+def _dir_bytes(path: Path) -> int:
+    return sum(p.stat().st_size for p in path.rglob("*") if p.is_file())
+
+
 def capture_device_trace(
     fn: Callable,
     payload_builder: Callable[[], Any],
@@ -55,8 +70,9 @@ def capture_device_trace(
     profile_reps: int = 1,
 ) -> dict[str, Any]:
     """Run ``profile_reps`` dedicated executions of ``fn`` on a freshly
-    built payload under ``jax.profiler.trace``, writing the xplane trace
-    to ``trace_root/<label>/``.  Returns capture metadata for the result
+    built payload under ``jax.profiler.trace``, writing both the xplane
+    trace and the parseable perfetto trace-event JSON to
+    ``trace_root/<label>/``.  Returns capture metadata for the result
     JSON / sweep manifest; the reps' timings are deliberately NOT
     returned — profile reps never enter a stats series."""
     import jax
@@ -78,17 +94,46 @@ def capture_device_trace(
         # must never consume either
         x = payload_builder()
         trace_dir.mkdir(parents=True, exist_ok=True)
-        with jax.profiler.trace(str(trace_dir)):
+        with jax.profiler.trace(str(trace_dir),
+                                create_perfetto_trace=True):
             with jax.profiler.TraceAnnotation(f"profile_rep:{label}"):
                 for _ in range(max(1, int(profile_reps))):
                     jax.block_until_ready(fn(x))
     except Exception as e:  # noqa: BLE001 — capture must not fail a config
         meta["error"] = f"{type(e).__name__}: {e}"
+        meta["error_kind"] = type(e).__name__
     meta["wall_seconds"] = time.perf_counter() - t0
+    if trace_dir.is_dir():
+        meta["trace_bytes"] = _dir_bytes(trace_dir)
+        traces = perfetto_trace_files(trace_dir)
+        if traces:
+            meta["perfetto_trace"] = str(traces[-1])
+        elif "error" not in meta:
+            # the profiler ran but produced nothing parseable — record
+            # it so the devtrace gate can fail closed with a clear
+            # finding instead of a silent empty report
+            meta["error"] = (
+                "capture produced no perfetto trace-event JSON under "
+                f"{trace_dir}"
+            )
+            meta["error_kind"] = "NoPerfettoTrace"
     return meta
 
 
 def xplane_files(trace_root: "str | Path") -> list[Path]:
-    """The ``.xplane.pb`` files under a capture directory — what a
-    capture must have produced to count as successful."""
+    """The ``.xplane.pb`` files under a capture directory — the raw
+    profiler output kept alongside the parseable trace."""
     return sorted(Path(trace_root).rglob("*.xplane.pb"))
+
+
+def perfetto_trace_files(trace_root: "str | Path") -> list[Path]:
+    """The parseable trace-event JSON file(s) under a capture directory
+    — what ``obs devtrace`` parses.  ``jax.profiler`` writes
+    ``perfetto_trace.json.gz``; the per-host ``*.trace.json.gz`` trace
+    (same event content, trace-viewer flavoured) is accepted as a
+    fallback for captures taken by external tooling."""
+    root = Path(trace_root)
+    primary = sorted(root.rglob("perfetto_trace.json.gz"))
+    if primary:
+        return primary
+    return sorted(root.rglob("*.trace.json.gz"))

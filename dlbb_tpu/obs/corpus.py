@@ -324,6 +324,43 @@ def ingest_calibration(path: Path, data: dict[str, Any],
     return samples, skipped
 
 
+_DEVTRACE_SAMPLE_KEYS = ("op", "kind", "ranks", "wire_bytes",
+                         "measured_median_us")
+
+
+def ingest_devtrace(path: Path, data: dict[str, Any]
+                    ) -> tuple[list[dict[str, Any]], list[dict]]:
+    """A devtrace report's ``op_samples`` are corpus rows too — the
+    op-granularity, device-timed half of the fit (``source:
+    "devtrace"``).  Each row is ONE collective op's measured device
+    communication time with ``dispatches: 0`` (a device-op duration
+    carries no host dispatch) and ``flops: 0`` (compute events are
+    bucketed separately), so the population identifies
+    ``α·collectives + wire/β`` directly — the rows that un-pin β on
+    the cpu-sim tier (``obs fit``)."""
+    samples: list[dict[str, Any]] = []
+    skipped: list[dict] = []
+    for n, row in enumerate(data.get("op_samples", ())):
+        if not isinstance(row, dict) or any(
+                k not in row for k in _DEVTRACE_SAMPLE_KEYS):
+            skipped.append({"file": f"{path}::op_samples[{n}]",
+                            "reason": "malformed devtrace op sample"})
+            continue
+        m = row.get("measured_median_us")
+        if not isinstance(m, (int, float)) or not math.isfinite(m) \
+                or m <= 0:
+            skipped.append({"file": f"{path}::op_samples[{n}]",
+                            "reason": "non-finite measured_median_us"})
+            continue
+        sample = dict(row)
+        sample.setdefault("source", "devtrace")
+        sample.setdefault("dispatches", 0.0)
+        sample.setdefault("flops", 0)
+        sample.setdefault("host", "devtrace")
+        samples.append(sample)
+    return samples, skipped
+
+
 def _manifest_summary(path: Path, data: dict[str, Any]) -> dict[str, Any]:
     """Compile/dedup aggregates of one ``sweep_manifest.json`` — corpus
     metadata (per-directory context for the samples), not samples."""
@@ -385,6 +422,11 @@ def build_corpus(roots: "Sequence[str | Path]",
                     path, data, baselines_dir=baselines_dir)
                 samples.extend(cal_samples)
                 skipped.extend(cal_skipped)
+                continue
+            if data.get("schema") == "dlbb_devtrace_v1":
+                dt_samples, dt_skipped = ingest_devtrace(path, data)
+                samples.extend(dt_samples)
+                skipped.extend(dt_skipped)
                 continue
             if _NON_SAMPLE_NAMES.match(path.name):
                 continue

@@ -1,0 +1,855 @@
+"""Device-trace analysis (``cli obs devtrace``).
+
+PR 8's gated capture writes raw profiler output that nothing in-repo
+parsed; this module closes that gap: each per-config capture's
+trace-event JSON (``perfetto_trace.json.gz``, written by
+``obs/capture.py`` with ``create_perfetto_trace=True``) is parsed into a
+per-op **measured** device timeline and joined against the static layer
+— the committed α–β schedule baselines (``stats/analysis/baselines/``).
+Three products per run directory:
+
+- **per-op measured durations** — device events bucketed by op kind
+  (collective / permute / dot / fusion / other) from the HLO instruction
+  names the events carry (``args.hlo_op``), keyed by instruction name so
+  rows join the ``analysis/hlo_audit`` instruction inventories;
+- **measured overlap efficiency** — the wall-occupancy of each
+  collective event covered by concurrently-executing compute events on
+  the same device, reported NEXT TO the schedule auditor's static
+  ``overlap_efficiency``, with a gate: a target whose static proof says
+  a ring hop is hidden but whose measured timeline shows the hop
+  serialized (zero straddling compute occupancy) is a
+  ``runtime-serialized-collective`` finding.  On a runtime whose capture
+  shows no inter-thunk concurrency anywhere (the cpu-sim thunk executor
+  runs each device single-stream, so hop hiding is *unobservable* there,
+  not disproved), the finding downgrades to a warning — the gate indicts
+  schedules, never backends;
+- **op-level fit samples** — per-collective rows (kind, ranks, analytic
+  wire bytes, measured device µs, ``dispatches: 0`` — device time
+  carries no host dispatch) appended to the ``obs/corpus.py`` sample
+  table as the ``devtrace`` source, letting ``obs fit`` identify β on
+  the cpu-sim tier from op-granularity data instead of pinning it from
+  cm1.
+
+Fail-closed contract: a run directory with no captures, a capture whose
+trace is missing/truncated/empty, or a capture carrying zero device
+events each produce an explicit error finding — never a silent empty
+report.  Exit codes follow the pinned ``analysis.findings.EXIT_*``
+contract (0 clean / 1 findings / 2 crash), like ``analyze`` and
+``obs diff``.
+
+Pure file processing — importable and runnable WITHOUT jax (the
+committed capture corpus regression-gates this module backend-free),
+mirroring ``obs/corpus.py``'s contract.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import math
+from pathlib import Path
+from typing import Any, Optional
+
+from dlbb_tpu.analysis.findings import (
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    Finding,
+)
+
+DEVTRACE_SCHEMA = "dlbb_devtrace_v1"
+DEFAULT_DEVTRACE_DIR = Path("stats/analysis/devtrace")
+
+BUCKETS = ("collective", "permute", "dot", "fusion", "other")
+
+# HLO instruction-name prefixes -> bucket.  Async ``-start``/``-done``
+# suffixes are stripped before matching, so an async pair's transfer
+# window and completion wait both charge the collective bucket.
+_COLLECTIVE_PREFIXES = (
+    "all-reduce", "all-gather", "reduce-scatter", "all-to-all",
+    "collective-broadcast", "reduce_scatter", "partial-reduce",
+)
+_DOT_PREFIXES = ("dot", "convolution")
+
+# container thunks whose device time is the SUM of their nested events
+# (``call`` wraps a computation whose fusions appear as their own
+# events; ``while``/``conditional`` likewise) — counting both the
+# container and its contents would double-charge every bucket
+_CONTAINER_PREFIXES = ("call", "while", "conditional", "async-start",
+                      "async-done", "async-update")
+
+
+def bucket_of(name: str) -> str:
+    """Op-kind bucket of one device event, from its HLO instruction
+    name (``fusion`` is matched as a substring: XLA names fused
+    computations ``<ops>_fusion[.N]``)."""
+    base = name.split(".")[0]
+    for suffix in ("-start", "-done", "-update"):
+        if base.endswith(suffix):
+            base = base[: -len(suffix)]
+    if base == "collective-permute":
+        return "permute"
+    if base.startswith(_COLLECTIVE_PREFIXES):
+        return "collective"
+    if base.startswith(_DOT_PREFIXES):
+        return "dot"
+    if "fusion" in base:
+        return "fusion"
+    return "other"
+
+
+def _is_container(name: str) -> bool:
+    return name.split(".")[0] in _CONTAINER_PREFIXES
+
+
+def _is_async_completion(name: str) -> bool:
+    """The ``-done``/``-update`` half of an async collective pair: its
+    wait time still charges the collective bucket, but it is not a
+    second instruction (α counts logical collectives) and its
+    frequently-zero duration must not classify as a serialized hop."""
+    base = name.split(".")[0]
+    return base.endswith(("-done", "-update"))
+
+
+class CaptureError(ValueError):
+    """A capture that cannot be parsed into a device timeline (missing,
+    truncated, or empty) — the caller turns this into an explicit
+    finding, never a silent skip."""
+
+
+# ---------------------------------------------------------------------------
+# capture parsing
+# ---------------------------------------------------------------------------
+
+
+def load_trace_events(path: "str | Path") -> list[dict[str, Any]]:
+    """The trace-event list of one capture (gz or plain JSON); raises
+    :class:`CaptureError` on anything unreadable."""
+    path = Path(path)
+    if not path.exists():
+        raise CaptureError(f"no trace file at {path}")
+    try:
+        raw = path.read_bytes()
+        if path.name.endswith(".gz"):
+            raw = gzip.decompress(raw)
+        data = json.loads(raw)
+    except (OSError, EOFError, gzip.BadGzipFile,
+            json.JSONDecodeError) as e:
+        raise CaptureError(
+            f"{path}: truncated or unparseable trace ({e})"
+        ) from e
+    events = data.get("traceEvents") if isinstance(data, dict) else data
+    if not isinstance(events, list) or not events:
+        raise CaptureError(f"{path}: trace holds no events")
+    return [e for e in events if isinstance(e, dict)]
+
+
+def _annotation_windows(events: list[dict[str, Any]]
+                        ) -> dict[str, list[tuple[float, float]]]:
+    """The harness-planted annotation windows: ``profile_rep:<label>``
+    (the dedicated capture reps), ``measure`` and ``warmup`` (the timing
+    loops under a whole-session ``--trace``).  Annotations surface as
+    host-thread X events whose full name rides ``args.long_name`` when
+    the display name was truncated at the colon."""
+    windows: dict[str, list[tuple[float, float]]] = {
+        "profile_rep": [], "measure": [], "warmup": [],
+    }
+    for ev in events:
+        if ev.get("ph") != "X" or "dur" not in ev:
+            continue
+        args = ev.get("args") or {}
+        name = str(args.get("long_name") or ev.get("name") or "")
+        span = (float(ev["ts"]), float(ev["ts"]) + float(ev["dur"]))
+        if name.startswith("profile_rep:"):
+            windows["profile_rep"].append(span)
+        elif name == "measure":
+            windows["measure"].append(span)
+        elif name == "warmup":
+            windows["warmup"].append(span)
+    return windows
+
+
+def _in_any(mid: float, spans: list[tuple[float, float]]) -> bool:
+    return any(lo <= mid <= hi for lo, hi in spans)
+
+
+def parse_capture(path: "str | Path") -> dict[str, Any]:
+    """One capture's trace-event JSON -> the device timeline:
+
+    ``{lanes: {(pid, tid) key: [event, ...]}, device_events,
+    excluded_warmup, windows}`` where each event is
+    ``{name, bucket, ts, dur, lane}``.  Device events are the X events
+    carrying ``args.hlo_op`` (the converter stamps every thunk with its
+    HLO instruction + module); container thunks (``call``/``while``)
+    are dropped — their nested fusions appear as their own events, and
+    counting both would double-charge the buckets.
+
+    Warmup reps are excluded: an event whose midpoint falls inside a
+    ``warmup`` annotation window is dropped; when ``profile_rep:`` /
+    ``measure`` windows exist, only events inside one of them are kept.
+    Raises :class:`CaptureError` when no device events survive — an
+    empty timeline must fail closed, not report zeroes.
+    """
+    events = load_trace_events(path)
+    windows = _annotation_windows(events)
+    keep_windows = windows["profile_rep"] + windows["measure"]
+    lanes: dict[str, list[dict[str, Any]]] = {}
+    excluded = 0
+    total = 0
+    for ev in events:
+        args = ev.get("args")
+        if (ev.get("ph") != "X" or not isinstance(args, dict)
+                or "hlo_op" not in args or "dur" not in ev):
+            continue
+        name = str(ev.get("name", args["hlo_op"]))
+        if _is_container(name):
+            continue
+        total += 1
+        ts, dur = float(ev["ts"]), float(ev["dur"])
+        mid = ts + dur / 2.0
+        if _in_any(mid, windows["warmup"]):
+            excluded += 1
+            continue
+        if keep_windows and not _in_any(mid, keep_windows):
+            excluded += 1
+            continue
+        lane = f"{ev.get('pid', 0)}/{ev.get('tid', 0)}"
+        lanes.setdefault(lane, []).append({
+            "name": name,
+            "bucket": bucket_of(name),
+            "ts": ts,
+            "dur": dur,
+            "lane": lane,
+        })
+    if not any(lanes.values()):
+        raise CaptureError(
+            f"{path}: no device events"
+            + (f" ({excluded} excluded as warmup/out-of-window,"
+               f" of {total} total)" if total else
+               " — the capture carries no hlo_op-stamped thunks")
+        )
+    # device grouping for the overlap analysis: a multi-device trace
+    # exports one perfetto process per device ("/device:TPU:0" ...), so
+    # lanes group by pid; the CPU-simulated mesh exports ONE host
+    # process whose per-device executor threads are the lanes, so each
+    # lane is its own device there
+    proc_names: dict[Any, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            proc_names[ev.get("pid")] = str(
+                (ev.get("args") or {}).get("name", ""))
+    devices: dict[str, list[dict[str, Any]]] = {}
+    for lane, evs in lanes.items():
+        pid = lane.split("/")[0]
+        pname = proc_names.get(int(pid) if pid.isdigit() else pid, "")
+        group = pid if "/device:" in pname else lane
+        devices.setdefault(group, []).extend(evs)
+    return {
+        "lanes": lanes,
+        "devices": devices,
+        "excluded_warmup": excluded,
+        "device_events": sum(len(v) for v in lanes.values()),
+        "windows": {k: len(v) for k, v in windows.items()},
+    }
+
+
+# ---------------------------------------------------------------------------
+# per-capture analysis
+# ---------------------------------------------------------------------------
+
+
+def _union_cover(span: tuple[float, float],
+                 others: list[tuple[float, float]]) -> float:
+    """Length of ``span`` covered by the union of ``others``."""
+    lo, hi = span
+    xs = sorted((max(a, lo), min(b, hi)) for a, b in others
+                if b > lo and a < hi)
+    covered = 0.0
+    cur_lo = cur_hi = None
+    for a, b in xs:
+        if cur_hi is None or a > cur_hi:
+            if cur_hi is not None:
+                covered += cur_hi - cur_lo
+            cur_lo, cur_hi = a, b
+        else:
+            cur_hi = max(cur_hi, b)
+    if cur_hi is not None:
+        covered += cur_hi - cur_lo
+    return covered
+
+
+def analyze_capture(timeline: dict[str, Any]) -> dict[str, Any]:
+    """Bucket totals, per-op duration rows (keyed by instruction name —
+    the ``hlo_audit`` inventory join key), and the measured-overlap
+    numbers of one parsed capture."""
+    per_op: dict[str, dict[str, Any]] = {}
+    buckets = {b: 0.0 for b in BUCKETS}
+    comm_total = hidden = 0.0
+    comm_count = 0
+    serialized: list[str] = []
+    straddled = 0
+    concurrent = False
+    for group in sorted(timeline["devices"]):
+        evs = timeline["devices"][group]
+        compute = [(e["ts"], e["ts"] + e["dur"]) for e in evs
+                   if e["bucket"] in ("dot", "fusion")]
+        spans = sorted((e["ts"], e["ts"] + e["dur"]) for e in evs)
+        for i in range(1, len(spans)):
+            if spans[i][0] < spans[i - 1][1] - 1e-3:
+                concurrent = True
+                break
+        for e in evs:
+            row = per_op.setdefault(e["name"], {
+                "name": e["name"], "bucket": e["bucket"], "count": 0,
+                "total_us": 0.0, "durations": [],
+            })
+            row["count"] += 1
+            row["total_us"] += e["dur"]
+            row["durations"].append(e["dur"])
+            buckets[e["bucket"]] += e["dur"]
+            if e["bucket"] in ("collective", "permute"):
+                comm_total += e["dur"]
+                cover = _union_cover((e["ts"], e["ts"] + e["dur"]),
+                                     compute)
+                hidden += min(cover, e["dur"])
+                # the -done half of an async pair is the same logical
+                # collective (and often zero-length — no window for
+                # compute to straddle); only the transfer-window events
+                # count as hops for the serialized gate
+                if _is_async_completion(e["name"]) or e["dur"] <= 0.0:
+                    continue
+                comm_count += 1
+                if cover <= 0.0:
+                    serialized.append(e["name"])
+                else:
+                    straddled += 1
+    rows = []
+    for name in sorted(per_op):
+        row = per_op[name]
+        ds = sorted(row.pop("durations"))
+        row["median_us"] = round(ds[len(ds) // 2], 3)
+        row["total_us"] = round(row["total_us"], 3)
+        rows.append(row)
+    return {
+        "per_op": rows,
+        "buckets_us": {b: round(v, 3) for b, v in buckets.items()},
+        "comm_events": comm_count,
+        "comm_total_us": round(comm_total, 3),
+        "hidden_us": round(hidden, 3),
+        "measured_overlap_efficiency": (
+            round(hidden / comm_total, 6) if comm_total > 0 else None
+        ),
+        "comm_serialized_events": len(serialized),
+        "comm_straddled_events": straddled,
+        # whether THIS capture ever executed two thunks concurrently on
+        # one device — the evidence the serialized-collective gate needs
+        # before it may indict a schedule (vs a single-stream runtime)
+        "runtime_concurrent": concurrent,
+    }
+
+
+def device_comm_samples(timeline: dict[str, Any],
+                        profile_reps: int = 1,
+                        buckets: "Optional[tuple[str, ...]]" = (
+                            "collective", "permute"),
+                        ) -> dict[str, Any]:
+    """Per-device totals of device time for the fit sample:
+    median-across-devices of each device's summed event time over
+    ``buckets`` (default communication only; ``None`` = every bucket —
+    the attribution device column), amortised per profile rep, plus
+    the per-device instruction count."""
+    totals: list[float] = []
+    counts: list[int] = []
+    for group in sorted(timeline["devices"]):
+        evs = [e for e in timeline["devices"][group]
+               if buckets is None or e["bucket"] in buckets]
+        if not evs:
+            continue
+        totals.append(sum(e["dur"] for e in evs))
+        # an async pair's -done event is the same logical collective:
+        # its wait time counts, the instruction does not (α's analytic
+        # convention counts one per hop, like corpus program rows)
+        counts.append(sum(1 for e in evs
+                          if not _is_async_completion(e["name"])))
+    if not totals:
+        return {}
+    totals.sort()
+    counts.sort()
+    reps = max(1, int(profile_reps))
+    return {
+        "measured_device_us": totals[len(totals) // 2] / reps,
+        "comm_instructions": counts[len(counts) // 2] / reps,
+        "devices": len(totals),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the static join (committed schedule baselines; no jax)
+# ---------------------------------------------------------------------------
+
+
+def audit_target_name(op: str, variant: str) -> str:
+    """The ``hlo_audit`` default-registry target a sweep config's
+    (op, variant) was audited as — the key into the committed schedule
+    baselines.  Mirrors the registry naming in
+    ``analysis/hlo_audit.py`` (pinned by ``tests/test_devtrace.py``)."""
+    if op in ("ag_matmul", "matmul_rs"):
+        schedule = {"overlap_ring": "ring",
+                    "overlap_bidir": "bidir"}.get(variant, "fused")
+        return f"comm/ops.py::{op}[{schedule}]"
+    if op.endswith("_q"):
+        return f"comm/ops.py::{op}[{'fp8' if 'fp8' in variant else 'int8'}]"
+    return f"comm/ops.py::{op}"
+
+
+def _static_join(baselines: dict[str, dict], op: str,
+                 variant: str) -> Optional[dict[str, Any]]:
+    base = baselines.get(audit_target_name(op, variant))
+    if base is None:
+        return None
+    return {
+        "target": base.get("target"),
+        "overlap_efficiency": base.get("overlap_efficiency"),
+        "critical_path_us": base.get("critical_path_us"),
+        "num_collectives": base.get("num_collectives"),
+        "tier": base.get("tier"),
+        "cost_model_version": base.get("cost_model_version"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# run-directory walk
+# ---------------------------------------------------------------------------
+
+
+def _resolve_capture_path(meta: dict[str, Any],
+                          input_dir: Path) -> Optional[Path]:
+    """The parseable trace file of one capture meta, tolerating
+    relative ``trace_dir`` records from runs launched in another cwd."""
+    from dlbb_tpu.obs.capture import perfetto_trace_files
+
+    explicit = meta.get("perfetto_trace")
+    if explicit and Path(explicit).exists():
+        return Path(explicit)
+    trace_dir = str(meta.get("trace_dir") or "")
+    if not trace_dir:
+        # Path("") is the cwd — rglobbing it would silently adopt an
+        # unrelated run's trace; a dir-less meta must fail closed
+        return None
+    rel = Path(trace_dir)
+    for root in (rel,
+                 # a capture dir under the run dir keeps its last two
+                 # components (<capture_subdir>/<label>) when the run
+                 # was launched from another cwd
+                 input_dir / rel.parent.name / rel.name,
+                 input_dir / rel.name):
+        if root.is_dir():
+            files = perfetto_trace_files(root)
+            if files:
+                return files[-1]
+    return None
+
+
+def _sweep_captures(input_dir: Path) -> list[dict[str, Any]]:
+    """Captured sweep configs: result JSONs carrying ``device_trace``
+    metadata, each with the artifact fields the fit-sample extraction
+    needs."""
+    out: list[dict[str, Any]] = []
+    for path in sorted(input_dir.glob("*.json")):
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        meta = data.get("device_trace")
+        if isinstance(meta, dict):
+            out.append({"kind": "config", "file": path, "data": data,
+                        "meta": meta})
+    return out
+
+
+def _serving_captures(input_dir: Path) -> list[dict[str, Any]]:
+    """Captured serving phases: the ``observability.device_captures``
+    metas the serving report/manifest records (one prefill + one decode
+    scan per run)."""
+    out: list[dict[str, Any]] = []
+    for path in sorted(input_dir.glob("serving_*.json")):
+        if path.name == "serving_resume.json":
+            continue
+        try:
+            data = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError):
+            continue
+        if not isinstance(data, dict):
+            continue
+        metas = (data.get("observability") or {}).get("device_captures")
+        if isinstance(metas, list):
+            for meta in metas:
+                if isinstance(meta, dict):
+                    out.append({"kind": "serving", "file": path,
+                                "data": data, "meta": meta})
+            break
+    return out
+
+
+def analyze_run(
+    input_dir: "str | Path",
+    baselines_dir: "Optional[str | Path]" = None,
+) -> tuple[dict[str, Any], list[Finding]]:
+    """Parse every capture a run directory recorded into the devtrace
+    report + findings.  Fail-closed: no captures at all, or a capture
+    that is missing/unparseable, is an explicit error finding."""
+    from dlbb_tpu.analysis.schedule_audit import (
+        DEFAULT_BASELINE_DIR,
+        load_baselines,
+    )
+
+    input_dir = Path(input_dir)
+    baselines_dir = Path(baselines_dir or DEFAULT_BASELINE_DIR)
+    baselines = (load_baselines(baselines_dir)
+                 if baselines_dir.is_dir() else {})
+    findings: list[Finding] = []
+    captures = _sweep_captures(input_dir) + _serving_captures(input_dir)
+    report: dict[str, Any] = {
+        "schema": DEVTRACE_SCHEMA,
+        "input_dir": str(input_dir),
+        "baselines_dir": str(baselines_dir),
+        "captures": [],
+        "op_samples": [],
+    }
+    if not captures:
+        findings.append(Finding(
+            pass_name="devtrace", rule="no-captures",
+            severity=SEVERITY_ERROR, target=str(input_dir),
+            message=(
+                "no device captures recorded under this directory — run "
+                "the sweep/serving benchmark with --device-trace DIR "
+                "(or DLBB_DEVICE_TRACE) so there is a timeline to "
+                "analyze; refusing to emit an empty report"
+            ),
+        ))
+        return report, findings
+
+    parsed_any = False
+    for cap in captures:
+        meta = cap["meta"]
+        label = str(meta.get("label", cap["file"].name))
+        row: dict[str, Any] = {
+            "label": label,
+            "source": cap["file"].name,
+            "kind": cap["kind"],
+            "capture": {k: meta.get(k) for k in (
+                "trace_dir", "perfetto_trace", "profile_reps",
+                "wall_seconds", "trace_bytes", "phase",
+            ) if k in meta},
+        }
+        if "error" in meta:
+            # contained at run time (and counted in
+            # obs_device_capture_failures_total); surfaced here so the
+            # report is explicit about what it does NOT cover
+            findings.append(Finding(
+                pass_name="devtrace", rule="capture-failed",
+                severity=SEVERITY_WARNING, target=label,
+                message=(f"capture failed at run time and was contained "
+                         f"({meta['error']}) — no timeline to analyze"),
+            ))
+            row["error"] = meta["error"]
+            report["captures"].append(row)
+            continue
+        trace_path = _resolve_capture_path(meta, input_dir)
+        if trace_path is None:
+            findings.append(Finding(
+                pass_name="devtrace", rule="capture-missing",
+                severity=SEVERITY_ERROR, target=label,
+                message=(
+                    f"result records a device capture under "
+                    f"{meta.get('trace_dir')} but no parseable "
+                    "perfetto trace-event JSON exists there — the "
+                    "capture artifact was moved or deleted"
+                ),
+            ))
+            row["error"] = "trace file missing"
+            report["captures"].append(row)
+            continue
+        try:
+            timeline = parse_capture(trace_path)
+        except CaptureError as e:
+            findings.append(Finding(
+                pass_name="devtrace", rule="capture-unparseable",
+                severity=SEVERITY_ERROR, target=label,
+                message=str(e),
+            ))
+            row["error"] = str(e)
+            report["captures"].append(row)
+            continue
+        parsed_any = True
+        analysis = analyze_capture(timeline)
+        row.update(analysis)
+        row["device_events"] = timeline["device_events"]
+        row["excluded_warmup"] = timeline["excluded_warmup"]
+        row["devices"] = len(timeline["devices"])
+
+        if cap["kind"] == "config":
+            data = cap["data"]
+            op = str(data.get("operation", ""))
+            variant = str(data.get("variant", "default"))
+            row["op"], row["variant"] = op, variant
+            row["ranks"] = int(data.get("num_ranks", 0))
+            row["static"] = _static_join(baselines, op, variant)
+            _gate_overlap(row, findings)
+            sample = _op_sample(cap, timeline, row)
+            if sample is not None:
+                report["op_samples"].append(sample)
+        else:
+            row["phase"] = meta.get("phase")
+        report["captures"].append(row)
+
+    if not parsed_any:
+        findings.append(Finding(
+            pass_name="devtrace", rule="no-captures",
+            severity=SEVERITY_ERROR, target=str(input_dir),
+            message=(
+                f"none of the {len(captures)} recorded capture(s) "
+                "yielded a parseable device timeline — see the "
+                "per-capture findings above; refusing to emit an "
+                "empty report"
+            ),
+        ))
+    return report, findings
+
+
+def _gate_overlap(row: dict[str, Any], findings: list[Finding]) -> None:
+    """The static-vs-measured overlap gate, for configs measuring a
+    ring-decomposed schedule (``overlap_*`` variants — the targets
+    whose static proof claims every hop is hidden).  Quantised-ring ops
+    (``*_q``) are exempt exactly as in the static auditor: their hop
+    chains are deliberately sequential."""
+    variant = row.get("variant", "")
+    op = row.get("op", "")
+    if not variant.startswith("overlap_") or op.endswith("_q"):
+        return
+    static = row.get("static") or {}
+    static_overlap = static.get("overlap_efficiency")
+    if not static_overlap or static_overlap <= 0:
+        return
+    hops = row.get("comm_events", 0)
+    serialized = row.get("comm_serialized_events", 0)
+    if not hops or not serialized:
+        return
+    # a single-stream runtime (no two thunks ever concurrent on one
+    # device in this capture) cannot exhibit hop hiding at all — the
+    # measured zero is an observability limit of the backend, not a
+    # schedule regression, so it warns instead of failing CI
+    severity = (SEVERITY_ERROR if row.get("runtime_concurrent")
+                else SEVERITY_WARNING)
+    measured = row.get("measured_overlap_efficiency")
+    findings.append(Finding(
+        pass_name="devtrace", rule="runtime-serialized-collective",
+        severity=severity, target=row["label"],
+        message=(
+            f"static proof claims overlap_efficiency="
+            f"{static_overlap:.2f} for {static.get('target')}, but the "
+            f"measured timeline shows {serialized}/{hops} ring hop "
+            f"event(s) with zero straddling compute occupancy "
+            f"(measured overlap "
+            f"{measured if measured is not None else 0:.2f})"
+            + ("" if severity == SEVERITY_ERROR else
+               " — single-stream runtime: no thunk concurrency "
+               "observed anywhere in this capture, so hiding is "
+               "unobservable on this backend, not disproved")
+        ),
+        details={
+            "static_overlap_efficiency": static_overlap,
+            "measured_overlap_efficiency": measured,
+            "serialized_events": serialized,
+            "comm_events": hops,
+            "runtime_concurrent": bool(row.get("runtime_concurrent")),
+        },
+    ))
+
+
+def _op_sample(cap: dict[str, Any], timeline: dict[str, Any],
+               row: dict[str, Any]) -> Optional[dict[str, Any]]:
+    """One corpus fit sample from a captured sweep config: the op's
+    analytic features joined with the measured device communication
+    time.  ``dispatches`` is 0 (a device-op duration carries no host
+    dispatch overhead) and ``flops`` 0 (compute events are bucketed
+    separately — the measured number is communication time only), so
+    the row identifies α·collectives + wire/β directly."""
+    from dlbb_tpu.obs.corpus import ingest_result
+
+    sample, _reason = ingest_result(cap["file"], cap["data"])
+    if sample is None:
+        return None
+    comm = device_comm_samples(
+        timeline, int(cap["meta"].get("profile_reps", 1)))
+    if not comm or comm["measured_device_us"] <= 0:
+        return None
+    return {
+        "file": f"{cap['file']}::devtrace",
+        "source": "devtrace",
+        "op": sample["op"],
+        "variant": sample["variant"],
+        "kind": sample["kind"],
+        "ranks": sample["ranks"],
+        "dtype": sample["dtype"],
+        "num_elements": sample["num_elements"],
+        "wire_bytes": sample["wire_bytes"],
+        "flops": 0,
+        "collectives": float(comm["comm_instructions"]),
+        "dispatches": 0.0,
+        "measured_median_us": float(comm["measured_device_us"]),
+        "measured_p90_us": float(comm["measured_device_us"]),
+        "measured_p99_us": None,
+        "iterations": int(cap["meta"].get("profile_reps", 1)),
+        "tier": sample["tier"],
+        "host": sample["host"],
+        "timestamp": sample.get("timestamp"),
+        "devices": comm["devices"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# report writers (JSON + MD + CSV via atomic_write_text)
+# ---------------------------------------------------------------------------
+
+
+def _fmt_us(us: Optional[float]) -> str:
+    if us is None or not math.isfinite(us):
+        return "-"
+    if us >= 1e6:
+        return f"{us / 1e6:.2f} s"
+    if us >= 1e3:
+        return f"{us / 1e3:.1f} ms"
+    return f"{us:.0f} us"
+
+
+def _fmt_eff(v: Optional[float]) -> str:
+    return f"{v:.2f}" if isinstance(v, (int, float)) else "-"
+
+
+def write_devtrace(report: dict[str, Any], findings: list[Finding],
+                   out_dir: "str | Path",
+                   name: str) -> tuple[Path, Path, Path]:
+    """``<name>.json`` (the machine report + findings), ``<name>.md``
+    (the human summary: measured overlap beside the static value per
+    target) and ``<name>.csv`` (flat per-op rows) under ``out_dir``."""
+    import csv
+    import io
+
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    payload = dict(report)
+    payload["findings"] = [f.to_dict() for f in findings]
+    json_path = atomic_write_text(
+        json.dumps(payload, indent=1, sort_keys=True),
+        out_dir / f"{name}.json",
+    )
+
+    caps = report.get("captures", [])
+    parsed = [c for c in caps if "error" not in c]
+    lines = [
+        f"# Device-trace analysis — {name}",
+        "",
+        f"- schema: `{DEVTRACE_SCHEMA}`",
+        f"- input: `{report.get('input_dir')}`",
+        f"- captures: {len(parsed)} parsed / {len(caps)} recorded",
+        f"- static join: `{report.get('baselines_dir')}`",
+        "",
+        "## Measured vs static overlap, per capture",
+        "",
+        "Measured overlap is the wall-occupancy of collective/permute "
+        "device events covered by concurrently-executing compute events "
+        "on the same device; the static value is the schedule auditor's "
+        "ASAP upper bound from the committed baseline "
+        "(docs/observability.md, \"Device-trace analysis\").",
+        "",
+        "| capture | target | dev events | comm | measured overlap | "
+        "static overlap | concurrency |",
+        "|---|---|---:|---:|---:|---:|---|",
+    ]
+    for c in parsed:
+        static = c.get("static") or {}
+        lines.append(
+            f"| {c['label']} | {static.get('target') or c.get('phase') or '-'} "
+            f"| {c.get('device_events', 0)} "
+            f"| {_fmt_us(c.get('comm_total_us'))} "
+            f"| {_fmt_eff(c.get('measured_overlap_efficiency'))} "
+            f"| {_fmt_eff(static.get('overlap_efficiency'))} "
+            f"| {'yes' if c.get('runtime_concurrent') else 'no'} |"
+        )
+    lines += ["", "## Bucket totals (device µs)", "",
+              "| capture | " + " | ".join(BUCKETS) + " |",
+              "|---|" + "---:|" * len(BUCKETS)]
+    for c in parsed:
+        b = c.get("buckets_us", {})
+        lines.append("| " + c["label"] + " | "
+                     + " | ".join(_fmt_us(b.get(k, 0.0)) for k in BUCKETS)
+                     + " |")
+    if report.get("op_samples"):
+        lines += [
+            "",
+            f"## Fit samples ({len(report['op_samples'])} op-level rows "
+            "appended to the cm2 corpus as source `devtrace`)",
+            "",
+            "| op | variant | ranks | wire bytes | measured device µs "
+            "| collectives |",
+            "|---|---|---:|---:|---:|---:|",
+        ]
+        for s in report["op_samples"]:
+            lines.append(
+                f"| {s['op']} | {s['variant']} | {s['ranks']} "
+                f"| {s['wire_bytes']} "
+                f"| {s['measured_median_us']:.1f} "
+                f"| {s['collectives']:.0f} |")
+    if findings:
+        lines += ["", "## Findings", ""]
+        lines += [f"- `{f.rule}` ({f.severity}) @ {f.target}: {f.message}"
+                  for f in findings]
+    lines.append("")
+    md_path = atomic_write_text("\n".join(lines), out_dir / f"{name}.md")
+
+    buf = io.StringIO()
+    writer = csv.DictWriter(buf, fieldnames=[
+        "capture", "target", "phase", "name", "bucket", "count",
+        "total_us", "median_us",
+    ])
+    writer.writeheader()
+    for c in parsed:
+        static = c.get("static") or {}
+        for op_row in c.get("per_op", ()):
+            writer.writerow({
+                "capture": c["label"],
+                "target": static.get("target", ""),
+                "phase": c.get("phase", ""),
+                **op_row,
+            })
+    csv_path = atomic_write_text(buf.getvalue(), out_dir / f"{name}.csv",
+                                 newline="")
+    return json_path, md_path, csv_path
+
+
+def run_devtrace(
+    input_dir: "str | Path",
+    out_dir: "Optional[str | Path]" = None,
+    baselines_dir: "Optional[str | Path]" = None,
+    name: Optional[str] = None,
+    verbose: bool = True,
+) -> tuple[dict[str, Any], list[Finding]]:
+    """CLI driver (``cli obs devtrace``): parse + join + write the
+    report set; the caller maps findings to the pinned exit codes."""
+    input_dir = Path(input_dir)
+    name = name or input_dir.resolve().name
+    report, findings = analyze_run(input_dir, baselines_dir)
+    json_path, md_path, _csv = write_devtrace(
+        report, findings, Path(out_dir or DEFAULT_DEVTRACE_DIR), name)
+    if verbose:
+        parsed = [c for c in report["captures"] if "error" not in c]
+        n_overlap = sum(1 for c in parsed if (c.get("static") or {})
+                        .get("overlap_efficiency"))
+        print(f"[obs] devtrace: {len(parsed)}/{len(report['captures'])} "
+              f"capture(s) parsed, {n_overlap} overlap-proof target(s), "
+              f"{len(report['op_samples'])} fit sample(s) -> {md_path}")
+    return report, findings

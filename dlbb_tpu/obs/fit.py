@@ -29,6 +29,13 @@ count equally), with:
   the program, γ is then pinned from a pre-fit over the FULL tier
   corpus (whose chained-timing rows amortise the dispatch and expose
   γ directly; recorded as ``gamma_pinned: "tier-corpus"``);
+- **device-timed op samples** — the devtrace source
+  (:mod:`dlbb_tpu.obs.devtrace`): per-collective measured device µs
+  with ``dispatches: 0`` and ``flops: 0``, exempt from ``host_filter``
+  (device wire time is a tier property, not a host-runtime one).
+  Program-scale samples alone cannot separate wire time from dispatch
+  overhead on the cpu-sim tier — these rows are what identifies β
+  there instead of pinning it from cm1;
 - **outlier rejection** — MAD-based trimming on relative residuals
   (default 6 MADs, two rounds): one noisy host spike must not drag β;
 - **fail-closed degeneracy checks** — too few samples, a single
@@ -90,20 +97,31 @@ def fit_tier(
     import numpy as np
 
     cm1 = get_tier(tier)  # validates the tier name against cm1's table
-    rows = [
+    usable = [
         s for s in samples
         if s.get("tier") == tier
         and _finite(s.get("measured_median_us"))
         and s.get("wire_bytes") is not None
     ]
+    # device-timed samples (the devtrace op rows, dispatches = 0) are
+    # EXEMPT from the host filter: the filter isolates the host-runtime
+    # dispatch overhead, which a device-op duration never carries —
+    # while the wire behaviour they measure is a property of the
+    # backend tier the fit predicts (they are what identifies β)
+    device_rows = [s for s in usable if s.get("source") == "devtrace"]
+    host_rows = [s for s in usable if s.get("source") != "devtrace"]
     gamma_pin: Optional[float] = None
     if host_filter:
-        all_rows = rows
-        rows = [s for s in rows if host_filter in str(s.get("host", ""))]
-        if len({float(s.get("dispatches", 1.0)) for s in rows}) == 1:
-            # the filtered population cannot identify γ (no dispatch-
-            # count variation); pin it from the full tier corpus — the
-            # host-runtime constant is population-independent
+        all_rows = usable
+        host_rows = [s for s in host_rows
+                     if host_filter in str(s.get("host", ""))]
+        if len({float(s.get("dispatches", 1.0))
+                for s in host_rows}) == 1:
+            # the filtered HOST population cannot identify γ (no
+            # dispatch-count variation; the device rows' zeros are no
+            # evidence of the per-dispatch cost, only of its absence);
+            # pin it from the full tier corpus — the host-runtime
+            # constant is population-independent
             try:
                 pre = fit_tier(all_rows, tier, min_samples=min_samples,
                                outlier_mad=outlier_mad)
@@ -111,6 +129,7 @@ def fit_tier(
                     "value"]
             except FitError:
                 gamma_pin = None  # full corpus degenerate too: fit free
+    rows = host_rows + device_rows
     if not rows:
         raise FitError(
             f"no usable corpus samples for tier {tier!r}"
@@ -143,11 +162,17 @@ def fit_tier(
     alpha_pinned = bool(np.allclose(ratio, ratio[0], rtol=1e-6))
     peak_pinned = bool(not np.any(f > 0))
 
+    # a corpus with no dispatch-bearing samples at all (device-timed
+    # rows only) carries zero evidence about γ — an all-zero column
+    # would poison the covariance (singular X'X, every CI lost), so γ
+    # pins to the cm1 seed (0) like any other unidentifiable term
+    gamma_zero_pin = gamma_pin is None and not bool(np.any(d > 0))
+
     y_fit = y.copy()
     cols: list[tuple[str, "np.ndarray"]] = []
     if gamma_pin is not None:
         y_fit = y_fit - gamma_pin * d
-    else:
+    elif not gamma_zero_pin:
         cols.append(("gamma_dispatch_us", d))
     if alpha_pinned:
         y_fit = y_fit - cm1.alpha_us * a
@@ -190,8 +215,12 @@ def fit_tier(
         [(n, c[keep]) for n, c in cols], y_fit[keep]
     )
 
-    gamma = (gamma_pin if gamma_pin is not None
-             else coef.get("gamma_dispatch_us", 0.0))
+    if gamma_pin is not None:
+        gamma = gamma_pin
+    elif gamma_zero_pin:
+        gamma = cm1.gamma_dispatch_us
+    else:
+        gamma = coef.get("gamma_dispatch_us", 0.0)
     alpha = cm1.alpha_us if alpha_pinned else coef.get("alpha_us", 0.0)
     beta_inv = coef.get("beta_inv", 0.0)
     peak_inv = coef.get("peak_inv", 0.0)
@@ -234,8 +263,10 @@ def fit_tier(
         c = coef.get(name, 0.0)
         lo, hi = c - 1.96 * se, c + 1.96 * se
         if invert:
-            # β / peak are fitted as inverses: invert the interval ends
-            hi_v = 1.0 / lo if lo > 0 else float("inf")
+            # β / peak are fitted as inverses: invert the interval ends;
+            # a lower inverse bound at/below zero means the upper end is
+            # unbounded — recorded as null (bare Infinity is not JSON)
+            hi_v = 1.0 / lo if lo > 0 else None
             lo_v = 1.0 / hi if hi > 0 else 0.0
             out.update(ci95=[lo_v, hi_v], stderr_inv=se)
         else:
@@ -246,6 +277,7 @@ def fit_tier(
         "gamma_dispatch_us": (
             {"value": gamma, "pinned": "tier-corpus"}
             if gamma_pin is not None
+            else {"value": gamma, "pinned": "cm1"} if gamma_zero_pin
             else _ci("gamma_dispatch_us", gamma, False)
         ),
         "alpha_us": (
@@ -273,10 +305,13 @@ def fit_tier(
         "residuals": residuals,
         "samples_used": int(keep.sum()),
         "samples_total": len(rows),
+        # the op-granularity device-timed rows (devtrace source) — the
+        # population that identifies β without a host dispatch term
+        "device_samples": len(device_rows),
         "outliers_rejected": int(len(rows) - keep.sum()),
         "alpha_pinned": alpha_pinned,
         "peak_pinned": peak_pinned,
-        "gamma_pinned": gamma_pin is not None,
+        "gamma_pinned": gamma_pin is not None or gamma_zero_pin,
         "host_filter": host_filter,
         "hosts": hosts,
         "distinct_wire_sizes": len(wires),

@@ -393,6 +393,19 @@ def journal_to_trace(journal_dir: "str | Path",
                 "ts": start_us, "dur": max(ts_us - start_us, 0.0),
                 "pid": pid, "tid": 1, "args": _jsonable(args),
             })
+        if name == "degraded":
+            # a degraded-probe fallback (PR 11) changes how EVERY later
+            # number in the run must be read — render it as a labelled,
+            # process-scoped instant (full-height marker in Perfetto)
+            # instead of a thread-local tick lost among the lifecycle
+            # events
+            reason = rec.get("reason") or "unknown"
+            events.append({
+                "name": f"degraded[{reason}]", "cat": "degraded",
+                "ph": "i", "s": "p", "ts": ts_us, "pid": pid, "tid": 1,
+                "args": _jsonable(args),
+            })
+            continue
         events.append({
             "name": name, "cat": "journal", "ph": "i", "s": "t",
             "ts": ts_us, "pid": pid, "tid": 1, "args": _jsonable(args),

@@ -118,6 +118,7 @@ def run_serving(
     verbose: bool = True,
     fault_plan: Optional[str] = None,
     collect_raw: bool = False,
+    device_trace: Optional[str] = None,
 ) -> dict[str, Any]:
     """Run one trace-driven serving benchmark.
 
@@ -131,9 +132,16 @@ def run_serving(
     else ``DLBB_FAULT_PLAN`` — the sweep engine's contract).  A
     SIGTERM mid-trace (or the ``serve-preempt`` site) drains
     gracefully and writes the ``serving_resume.json`` checkpoint
-    instead of the result artifact — see :func:`resume_serving`."""
+    instead of the result artifact — see :func:`resume_serving`.
+
+    ``device_trace`` (``--device-trace`` / ``DLBB_DEVICE_TRACE``)
+    routes through the same ``obs/capture`` gate as sweeps: one
+    captured prefill + one captured decode scan per run, AFTER the
+    trace has been served (strictly outside timed regions), contained
+    failures counted in ``obs_device_capture_failures_total``."""
     import os
 
+    from dlbb_tpu.obs import capture as obs_capture
     from dlbb_tpu.obs import spans
     from dlbb_tpu.obs.export import serving_metrics
     from dlbb_tpu.parallel.plan import ParallelismPlan
@@ -209,6 +217,30 @@ def run_serving(
     report["mesh"] = plan.mesh_dict()
     report["system_info"] = collect_system_info()
     report["timestamp"] = time.time()
+
+    # serving capture parity (docs/observability.md): the gated device
+    # capture runs AFTER the trace has been served — never inside a
+    # timed region — on fresh state, one prefill + one decode scan
+    capture_dir = device_trace or obs_capture.default_capture_dir()
+    if capture_dir and not report.get("preempted"):
+        with spans.span("device-capture", cat="capture", label="serve"):
+            metas = engine.capture_device_traces(capture_dir)
+        for m in metas:
+            if "error" in m:
+                engine.registry.inc(
+                    "obs_device_capture_failures",
+                    reason=m.get("error_kind", "unknown"),
+                    help="contained device-capture failures "
+                         "(error recorded in the capture metadata)",
+                )
+        report["observability"] = {
+            "device_trace_dir": str(capture_dir),
+            "device_captures": metas,
+        }
+        if verbose:
+            ok = sum(1 for m in metas if "error" not in m)
+            print(f"[serve] device capture: {ok}/{len(metas)} phase "
+                  f"capture(s) under {capture_dir}")
 
     if out is not None:
         trace_path = trace.save(out / f"trace_{name}.json")
@@ -511,6 +543,7 @@ def run_serve_from_config(
     resume: bool = False,
     fault_plan: Optional[str] = None,
     slo: Optional[float] = None,
+    device_trace: Optional[str] = None,
 ) -> dict[str, Any]:
     """CLI entry: optional experiment YAML + flag overrides (including
     the decode fast-path knobs — decode_horizon / inflight_window /
@@ -552,4 +585,5 @@ def run_serve_from_config(
     out = output_dir or config.get("experiment", {}).get(
         "output_dir", "results/serving")
     return run_serving(config, resolved, output_dir=out, devices=devices,
-                       verbose=verbose, fault_plan=fault_plan)
+                       verbose=verbose, fault_plan=fault_plan,
+                       device_trace=device_trace)

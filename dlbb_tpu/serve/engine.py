@@ -1034,6 +1034,78 @@ class ServingEngine:
         )
         return (cache, x)
 
+    def capture_device_traces(self, trace_root: Any) -> list[dict]:
+        """Serving capture parity with the sweep engine's gated capture
+        (docs/observability.md): ONE dedicated prefill and ONE decode
+        scan (fused when the fast path is configured) captured through
+        ``obs/capture.py`` on FRESH state, strictly outside every timed
+        region — the bench calls this after ``run_trace`` has returned,
+        so no capture overhead can touch TTFT/goodput.  Each returned
+        meta carries its ``phase`` so the devtrace report renders
+        per-phase rows; failures are contained in the metas exactly as
+        sweep captures are."""
+        from dlbb_tpu.obs import capture as obs_capture
+
+        cfg = self.serving
+        bucket = cfg.prefill_buckets[0]
+
+        def prefill_payload():
+            carry = self._fresh_carry()
+            x = request_embeddings(0, bucket, self.config.hidden_size,
+                                   dtype=self._dtype, pad_to=bucket)
+            return (carry[0], x)
+
+        def prefill_fn(t):
+            return self._prefill(t[0], self.params, t[1], np.int32(0),
+                                 np.int32(bucket))
+
+        metas = [obs_capture.capture_device_trace(
+            prefill_fn, prefill_payload, trace_root,
+            label=f"serve_prefill_b{bucket}")]
+        metas[0]["phase"] = "prefill"
+
+        if self._fast and self._fused_ks:
+            k = min(self._fused_ks)
+            fused = self._decode_fused[k]
+
+            def decode_fn(t):
+                return fused(t[0], self.params, t[1], t[2])
+
+            def decode_payload():
+                return (self._fresh_carry(), self._zero_active(),
+                        self._zero_remaining())
+
+            label = f"serve_decode_fused_k{k}"
+        else:
+            def decode_fn(t):
+                return self._decode(t[0], self.params, t[1])
+
+            def decode_payload():
+                return (self._fresh_carry(), self._zero_active())
+
+            label = "serve_decode_step"
+        meta = obs_capture.capture_device_trace(
+            decode_fn, decode_payload, trace_root, label=label)
+        meta["phase"] = "decode"
+        # token steps the captured program executes per dispatch — the
+        # run's scans vary k, so downstream device-time accounting must
+        # normalise per STEP, never per dispatch
+        meta["decode_steps_per_scan"] = (min(self._fused_ks)
+                                         if self._fast and self._fused_ks
+                                         else 1)
+        metas.append(meta)
+        return metas
+
+    def _zero_active(self) -> jax.Array:
+        return jax.device_put(
+            jnp.zeros((self.serving.max_batch,), bool),
+            self._active_sharding)
+
+    def _zero_remaining(self) -> jax.Array:
+        return jax.device_put(
+            jnp.zeros((self.serving.max_batch,), jnp.int32),
+            self._active_sharding)
+
     def _infeasible_reason(self, r: Request) -> Optional[str]:
         """Why the envelope can never serve ``r`` (None = feasible)."""
         max_bucket = self.serving.prefill_buckets[-1]

@@ -1,0 +1,113 @@
+"""Publish the committed device-capture corpus + the β-identified cm2
+refit (docs/observability.md, "Device-trace analysis").
+
+One command regenerates the whole committed chain:
+
+1. a captured sim-mesh mini-sweep (4 registry collectives x 4 payload
+   sizes + the four overlap-proof collective-matmul schedules) into
+   ``results/fit_corpus/devtrace/sim8/`` — result JSONs with capture
+   metadata, perfetto trace-event JSON + xplane per config;
+2. ``obs devtrace`` over it into ``stats/analysis/devtrace/sim8.*`` —
+   the per-op measured timelines, measured-vs-static overlap table and
+   the op-level fit samples;
+3. ``obs fit`` over the full corpus (program-scale artifacts +
+   calibration rows + the new device-timed op samples) appending a new
+   version to ``stats/analysis/costmodel_fit/cm2_cpu-sim.json`` — the
+   version where β is identified from op-granularity device time
+   instead of pinned from cm1;
+4. ``obs calibrate --model cm2`` against the new fit, committing the
+   regenerated ``calibration_baseline_cm2.json`` the
+   ``obs diff --model cm2`` CI gate compares against.
+
+Run from the repo root on an OTHERWISE-IDLE host (the same discipline
+as the PR-12 baseline regeneration — a loaded host silently loosens
+the diff gate):
+
+    JAX_PLATFORMS=cpu python scripts/publish_devtrace_corpus.py
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dlbb_tpu.utils.simulate import force_cpu_simulation  # noqa: E402
+
+force_cpu_simulation(8)
+
+CORPUS_DIR = Path("results/fit_corpus/devtrace/sim8")
+CAPTURE_DIR = CORPUS_DIR / "captures"
+DEVTRACE_DIR = Path("stats/analysis/devtrace")
+
+SIZES = (("1KB", 256), ("64KB", 16384), ("1MB", 262144),
+         ("16MB", 4194304))
+OPS_1D = ("allreduce", "allgather", "reducescatter", "alltoall")
+OVERLAP = (("ag_matmul", "overlap_ring"), ("ag_matmul", "overlap_bidir"),
+           ("matmul_rs", "overlap_ring"), ("matmul_rs", "overlap_bidir"))
+
+
+def main() -> int:
+    from dlbb_tpu.bench import Sweep1D, Sweep3D, run_sweep
+    from dlbb_tpu.obs import run_obs
+    from dlbb_tpu.obs.calibration import (
+        run_calibration,
+        save_calibration_baseline,
+    )
+
+    print("[1/4] captured mini-sweep ->", CORPUS_DIR)
+    run_sweep(Sweep1D(
+        operations=OPS_1D,
+        data_sizes=SIZES,
+        rank_counts=(8,),
+        warmup_iterations=2,
+        measurement_iterations=8,
+        output_dir=str(CORPUS_DIR),
+        pipeline=False,
+        compile_cache="off",
+        device_trace_dir=str(CAPTURE_DIR),
+    ), verbose=False)
+    for op, variant in OVERLAP:
+        run_sweep(Sweep3D(
+            operations=(op,),
+            variant=variant,
+            batch_sizes=(8,),
+            seq_lengths=(64,),
+            hidden_dims=(128,),
+            rank_counts=(8,),
+            warmup_iterations=2,
+            measurement_iterations=8,
+            output_dir=str(CORPUS_DIR),
+            pipeline=False,
+            compile_cache="off",
+            device_trace_dir=str(CAPTURE_DIR),
+        ), verbose=False)
+
+    print("[2/4] obs devtrace ->", DEVTRACE_DIR)
+    rc = run_obs("devtrace", journal=str(CORPUS_DIR),
+                 output=str(DEVTRACE_DIR))
+    if rc != 0:
+        print(f"devtrace gate not clean (exit {rc}) — corpus NOT "
+              "published")
+        return rc
+
+    print("[3/4] obs fit (program corpus + device op samples)")
+    rc = run_obs("fit",
+                 journal=None, output=None,
+                 results=["results/fit_corpus",
+                          str(DEVTRACE_DIR / "sim8.json")],
+                 tier="cpu-sim", host_filter="calibration")
+    if rc != 0:
+        print(f"fit refused (exit {rc})")
+        return rc
+
+    print("[4/4] obs calibrate --model cm2 -> committed baseline")
+    report = run_calibration(out_dir=Path("results/obs"), model="cm2")
+    path = save_calibration_baseline(report)
+    print("baseline written:", path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

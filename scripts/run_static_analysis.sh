@@ -74,6 +74,39 @@ grep -q '"fit_version"' "$FIT_TMP/cal/sweep_manifest.json" \
          exit 1; }
 rm -rf "$FIT_TMP"
 
+# devtrace_smoke (docs/observability.md, "Device-trace analysis"): the
+# captured pipeline end-to-end — the pytest marker runs a
+# device-captured overlap-variant mini-sweep that must publish stats
+# byte-equivalent to an uncaptured run (same proof style as obs_smoke)
+# with `obs devtrace` green over it (measured overlap beside the
+# committed static value, op-level fit samples mined); the unit tests
+# in the same file pin bucket classification, warmup exclusion, the
+# fail-closed contract and the serialized-ring gate on the committed
+# golden capture.  Then the committed capture corpus re-parses
+# BACKEND-FREE (exit 0: serialized-ring findings downgrade to warnings
+# on the single-stream cpu-sim runtime by contract), and the
+# β-identification round trip proves out into a THROWAWAY DB: fitting
+# the program corpus + the committed devtrace report must identify β
+# from the device-timed op samples — no pinned-from-cm1 marker (the
+# committed-DB `obs fit` + `obs diff --model cm2` gate runs in
+# fit_smoke above).  Zero suppressions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_devtrace.py -q \
+    -m devtrace_smoke -p no:cacheprovider
+DT_TMP="$(mktemp -d)"
+python -m dlbb_tpu.cli obs devtrace \
+    --journal results/fit_corpus/devtrace/sim8 --output "$DT_TMP"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli obs fit \
+    --results results/fit_corpus stats/analysis/devtrace/sim8.json \
+    --tier cpu-sim --host calibration --fit-dir "$DT_TMP/db"
+python - "$DT_TMP/db/cm2_cpu-sim.json" <<'PY'
+import json, sys
+v = json.load(open(sys.argv[1]))["versions"][-1]
+beta = v["coefficients"]["beta_bytes_per_us"]
+assert "pinned" not in beta, f"devtrace_smoke: beta still pinned: {beta}"
+assert v.get("device_samples"), "devtrace_smoke: no device samples used"
+PY
+rm -rf "$DT_TMP"
+
 # compile-ahead sweep-engine smoke (bench/schedule.py is covered by the
 # lint pass above; this exercises the pipelined path end-to-end on the
 # simulated mesh — 2-op mini-sweep, compile accounting, manifest)

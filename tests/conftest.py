@@ -103,6 +103,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "devtrace_smoke: device-trace analysis smoke — captured "
+        "overlap-variant mini-sweep stays stats-equivalent to an "
+        "uncaptured run and `obs devtrace` reports measured overlap "
+        "beside the static proof (tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )
