@@ -1,8 +1,8 @@
 """comm-lint: static verification that benchmarks match their parallelism
 plan.
 
-Three passes (see docs/analysis.md + docs/schedule_audit.md for the rule
-catalogues):
+Four passes (see docs/analysis.md + docs/schedule_audit.md +
+docs/memory_audit.md for the rule catalogues):
 
 - ``hlo``      — lower + compile every registered benchmark computation on
   the current (usually ``--simulate N`` CPU) mesh and audit the post-SPMD
@@ -12,22 +12,30 @@ catalogues):
   overlap verification (every ring hop must have a straddling matmul),
   critical-path estimate, divergent-branch deadlock check
   (``schedule_audit``).
+- ``memory``   — the buffer-liveness memory auditor over the same
+  modules: per-target ``peak_live_bytes`` (donation/aliasing-aware,
+  while/conditional/fusion composed), analytic peak ceilings, the
+  transient-replicated-buffer spike gate, the serving cache
+  cross-check, and ``hbm_headroom`` feasibility per cost tier
+  (``memory_audit``).
 - ``lint``     — AST rules over ``dlbb_tpu/`` and ``scripts/`` for host
   syncs and wall-clock reads in timed regions, undonated train-step jits,
-  jit-in-loop recompile hazards, unsorted set iteration, and non-atomic
-  artifact writes (``source_lint``).
+  jit-in-loop recompile hazards, per-iteration host transfers in loops,
+  unsorted set iteration, and non-atomic artifact writes
+  (``source_lint``).
 
-Plus the regression-baseline gate over the schedule pass:
+Plus the regression-baseline gate over the schedule + memory passes:
 
 - ``snapshot`` — write per-target baselines to ``stats/analysis/baselines``
   (refuses while the audit itself has error findings).
 - ``diff``     — compare a fresh audit against the committed baselines and
-  fail on unexplained growth (>10 % critical path / wire, new collective
-  kind).
+  fail on unexplained growth (>10 % critical path / wire / peak memory /
+  largest transient, new collective kind).
 
-CLI: ``python -m dlbb_tpu.cli analyze [hlo|lint|schedule|all|snapshot|diff]
---simulate 8``.  Exit codes are a pinned contract (``findings.EXIT_*``):
-0 = clean, 1 = findings, 2 = the analyzer crashed.
+CLI: ``python -m dlbb_tpu.cli analyze
+[hlo|lint|schedule|memory|all|snapshot|diff] --simulate 8``.  Exit codes
+are a pinned contract (``findings.EXIT_*``): 0 = clean, 1 = findings,
+2 = the analyzer crashed.
 """
 
 from __future__ import annotations
@@ -48,10 +56,15 @@ from dlbb_tpu.analysis.source_lint import run_source_lint  # noqa: F401
 _HLO_PASSES = {
     "hlo": ("hlo",),
     "schedule": ("schedule",),
-    "all": ("hlo", "schedule"),
-    "snapshot": ("hlo", "schedule"),
-    "diff": ("hlo", "schedule"),
+    "memory": ("memory",),
+    "all": ("hlo", "schedule", "memory"),
+    "snapshot": ("hlo", "schedule", "memory"),
+    "diff": ("hlo", "schedule", "memory"),
 }
+
+# memory-meta keys folded into the per-target baseline snapshots next to
+# the schedule keys (the one committed gate file per target)
+_MEMORY_BASELINE_KEYS = ("peak_live_bytes", "max_transient_bytes")
 
 
 def run_analysis(
@@ -63,6 +76,7 @@ def run_analysis(
     baselines: Optional[str] = None,
     tier: Optional[str] = None,
     model: str = "cm1",
+    output: Optional[str] = None,
 ) -> int:
     """Run the requested passes; print the human summary; optionally write
     the JSON report.  Returns the pinned exit code: 0 clean / 1 findings /
@@ -73,7 +87,7 @@ def run_analysis(
         return _run_analysis(
             which=which, root=root, json_path=json_path, verbose=verbose,
             strict_warnings=strict_warnings, baselines=baselines, tier=tier,
-            model=model,
+            model=model, output=output,
         )
     except Exception:  # noqa: BLE001 — the exit-code contract
         import traceback
@@ -91,6 +105,7 @@ def _run_analysis(
     baselines: Optional[str],
     tier: Optional[str],
     model: str = "cm1",
+    output: Optional[str] = None,
 ) -> int:
     from dlbb_tpu.analysis.schedule_audit import DEFAULT_BASELINE_DIR
 
@@ -117,6 +132,34 @@ def _run_analysis(
                 ),
             ))
         report.extend(hlo)
+
+    # the memory pass rides the same per-target baseline snapshots as the
+    # schedule pass: fold its gate keys into the schedule meta so
+    # `analyze snapshot`/`diff` carry (and regression-gate) the memory
+    # axis alongside critical path and wire volume
+    if which in ("all", "snapshot", "diff"):
+        for target, mem in report.memory.items():
+            dest = report.schedule.setdefault(target, {})
+            for key in _MEMORY_BASELINE_KEYS:
+                if key in mem:
+                    dest[key] = mem[key]
+
+    if output and report.memory:
+        # the observability surface (`analyze memory --output DIR`,
+        # docs/memory_audit.md): peak bytes + the audit tier land in the
+        # directory's sweep_manifest.json, and an
+        # analysis_peak_live_bytes{target} gauge per target folds into
+        # metrics.prom next to the calibration-health gauges
+        from dlbb_tpu.analysis.costmodel import resolve_tier
+        from dlbb_tpu.analysis.hlo_audit import default_tier
+        from dlbb_tpu.analysis.memory_audit import write_memory_artifacts
+
+        cost_tier = resolve_tier(tier or default_tier(), model=model,
+                                 warn=False)
+        path = write_memory_artifacts(report.memory, output, cost_tier)
+        if verbose:
+            print(f"[analyze] memory report written to {path} "
+                  "(manifest + metrics.prom updated)")
 
     base_dir = Path(baselines) if baselines else DEFAULT_BASELINE_DIR
     if which == "snapshot":

@@ -63,6 +63,16 @@ class CostTier:
     gamma_dispatch_us:  per-dispatch host overhead (trace/launch/sync of
                         one jitted program) — 0 in cm1 (un-modelled, the
                         committed ~289x cpu-sim gap), fitted in cm2.
+    hbm_bytes:          per-device memory capacity the tier's programs
+                        must fit in (HBM on a real chip; a notional
+                        per-fake-device share of host RAM on the sim
+                        mesh).  0 = unknown/unchecked.  This is a
+                        CAPACITY record, not a priced coefficient — it
+                        feeds the memory auditor's ``hbm_headroom`` /
+                        feasibility term (``memory_audit.py``, the
+                        ``cli plan --auto`` pruning input), never a
+                        µs prediction, so changing it does not bump
+                        COST_MODEL_VERSION.
     version:            the cost model the numbers came from ("cm1"
                         analytic seeds, "cm2" fitted) — reports and
                         baselines record this, and diff gates refuse to
@@ -77,6 +87,7 @@ class CostTier:
     peak_flops_per_us: float
     description: str = ""
     gamma_dispatch_us: float = 0.0
+    hbm_bytes: float = 0.0
     version: str = COST_MODEL_VERSION
     fit: Optional[dict] = field(default=None, compare=False)
 
@@ -90,6 +101,7 @@ COST_MODELS: dict[str, dict[str, CostTier]] = {
             alpha_us=1.0,
             beta_bytes_per_us=10_000.0,      # ~10 GB/s shared-memory copy
             peak_flops_per_us=50_000.0,      # ~50 GFLOP/s single core
+            hbm_bytes=2.0 * 2**30,           # ~2 GiB host-RAM share/device
             description="--simulate N host-process mesh (CI baseline tier)",
         ),
         "tpu-v5lite": CostTier(
@@ -97,6 +109,7 @@ COST_MODELS: dict[str, dict[str, CostTier]] = {
             alpha_us=1.0,
             beta_bytes_per_us=45_000.0,      # ~45 GB/s/dir ICI link
             peak_flops_per_us=197_000_000.0,  # 197 TFLOP/s bf16 peak
+            hbm_bytes=16.0 * 2**30,          # 16 GiB HBM per v5e chip
             description="TPU v5e single slice, ICI ring",
         ),
         "tpu-v5lite-dcn": CostTier(
@@ -104,6 +117,7 @@ COST_MODELS: dict[str, dict[str, CostTier]] = {
             alpha_us=10.0,
             beta_bytes_per_us=12_500.0,      # ~100 Gb/s DCN
             peak_flops_per_us=197_000_000.0,
+            hbm_bytes=16.0 * 2**30,
             description="TPU v5e cross-slice data-center network",
         ),
     },
@@ -148,6 +162,26 @@ def dispatch_cost_us(dispatch_count: int, tier: CostTier) -> float:
     prediction for one program execution is ``critical_path_us +
     dispatch_cost_us(1, tier)``."""
     return dispatch_count * tier.gamma_dispatch_us
+
+
+def hbm_headroom_bytes(peak_bytes: int, tier: CostTier) -> Optional[int]:
+    """Per-device memory headroom of a program whose audited
+    ``peak_live_bytes`` is ``peak_bytes`` on ``tier`` — the feasibility
+    term of the target report (``memory_audit.py``): a plan point with
+    negative headroom OOMs before its α–β time matters, so the future
+    ``cli plan --auto`` search prunes it statically instead of
+    measuring through the failure.  None when the tier records no
+    capacity."""
+    if not tier.hbm_bytes:
+        return None
+    return int(tier.hbm_bytes) - int(peak_bytes)
+
+
+def memory_feasible(peak_bytes: int, tier: CostTier) -> Optional[bool]:
+    """Whether a program with the given audited peak fits the tier's
+    per-device memory (None = the tier records no capacity)."""
+    headroom = hbm_headroom_bytes(peak_bytes, tier)
+    return None if headroom is None else headroom >= 0
 
 
 # ---------------------------------------------------------------------------
@@ -220,6 +254,7 @@ def load_fitted_tier(
         beta_bytes_per_us=_v("beta_bytes_per_us", cm1.beta_bytes_per_us),
         peak_flops_per_us=_v("peak_flops_per_us", cm1.peak_flops_per_us),
         gamma_dispatch_us=_v("gamma_dispatch_us", 0.0),
+        hbm_bytes=cm1.hbm_bytes,  # capacity record, never fitted
         description=(f"fitted from the sweep corpus "
                      f"(fit v{entry.get('fit_version')}); "
                      f"seed: {cm1.description}"),

@@ -246,6 +246,24 @@ class TargetExpectation:
                         ``serialized-collective`` error for every ring
                         hop with no straddling matmul
                         (``schedule_audit.analyze_schedule``).
+    max_peak_bytes:     per-device ceiling on the program's audited
+                        ``peak_live_bytes`` (the buffer-liveness pass,
+                        ``memory_audit.py``; None = unchecked).  Seeded
+                        from analytic model/cache sizes with slack —
+                        the byte-ceiling's whole-program twin: a
+                        replicated state pytree or an undonated carry
+                        blows it even when every wire instruction looks
+                        right.
+    donated_bytes_expected: analytic per-device bytes the program's
+                        donated input buffers must sum to, within
+                        ``donated_bytes_tolerance`` (relative).  The
+                        serving cross-check: the decode step's donated
+                        cache carry must agree with
+                        ``models.configs.kv_cache_bytes_per_device`` —
+                        the same number ``validate_serving``'s HBM
+                        budget gate prices — so the build-time
+                        rejection can never drift from what XLA
+                        actually allocates (``serving-cache-drift``).
     """
 
     allowed: set[str] = field(default_factory=set)
@@ -255,6 +273,9 @@ class TargetExpectation:
     max_total_wire_bytes: Optional[int] = None
     expect_donation: bool = False
     expect_overlap: bool = False
+    max_peak_bytes: Optional[int] = None
+    donated_bytes_expected: Optional[int] = None
+    donated_bytes_tolerance: float = 0.10
 
 
 def op_expectation(op_name: str, payload_bytes_per_rank: int,

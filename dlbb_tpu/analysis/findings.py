@@ -80,6 +80,10 @@ class AnalysisReport:
     # target name -> schedule meta (critical_path_us / overlap_efficiency
     # / inventory; schedule_audit.analyze_schedule) — the baseline payload
     schedule: dict[str, dict] = field(default_factory=dict)
+    # target name -> memory meta (peak_live_bytes / live set at peak /
+    # transients; memory_audit.analyze_memory) — feeds the same baseline
+    # snapshots as the schedule pass
+    memory: dict[str, dict] = field(default_factory=dict)
 
     def extend(self, other: "AnalysisReport") -> None:
         self.findings.extend(other.findings)
@@ -88,6 +92,7 @@ class AnalysisReport:
         self.files_linted += other.files_linted
         self.skipped_targets.extend(other.skipped_targets)
         self.schedule.update(other.schedule)
+        self.memory.update(other.memory)
 
     @property
     def errors(self) -> list[Finding]:
@@ -108,6 +113,7 @@ class AnalysisReport:
         return {
             "findings": [f.to_dict() for f in self.findings],
             "schedule": self.schedule,
+            "memory": self.memory,
             "summary": {
                 "errors": len(self.errors),
                 "warnings": len(self.warnings),
@@ -136,6 +142,8 @@ class AnalysisReport:
             f"{self.files_linted} file(s) linted"
             + (f", {len(self.schedule)} schedule report(s)"
                if self.schedule else "")
+            + (f", {len(self.memory)} memory report(s)"
+               if self.memory else "")
             + (f", {len(self.skipped_targets)} target(s) skipped"
                if self.skipped_targets else "")
         )

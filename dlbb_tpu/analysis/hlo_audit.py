@@ -88,6 +88,22 @@ def audit_target(
         )
         findings.extend(sched_findings)
         meta["schedule"] = sched_meta
+    if "memory" in passes:
+        from dlbb_tpu.analysis.costmodel import CostTier
+        from dlbb_tpu.analysis.memory_audit import analyze_memory
+
+        mem_findings, mem_meta = analyze_memory(
+            module, exp, target.name,
+            lowered_text=lowered.as_text(),
+            # the TARGET's mesh size, not the host's device count: every
+            # builder stands up exactly min_devices devices (a dp1 x tp4
+            # compaction target on an 8-device host still runs a 4-way
+            # mesh, and the replicated-spike P-factor must match it)
+            num_devices=max(1, target.min_devices),
+            tier=tier if isinstance(tier, CostTier) else None,
+        )
+        findings.extend(mem_findings)
+        meta["memory"] = mem_meta
     if "hlo" not in passes:
         return findings, meta
 
@@ -229,6 +245,16 @@ _TINY_MODEL = dict(hidden_size=64, num_layers=2, num_heads=4,
 _MATMUL_SHAPE = (2, 16, 64)
 
 
+def _tiny_params_bytes() -> int:
+    """f32 parameter bytes of the shared tiny audit model — the unit every
+    model/train/serve peak-memory ceiling is priced in (the analytic
+    "model size" the memory audit's ceilings are seeded from)."""
+    from dlbb_tpu.models.configs import ModelConfig
+    from dlbb_tpu.models.transformer import num_parameters
+
+    return num_parameters(ModelConfig(**_TINY_MODEL)) * 4
+
+
 def _collective_matmul_target(op_name: str, schedule: str,
                               num_ranks: int = 8) -> AuditTarget:
     """One audit target per (micro-op, schedule).  The fused schedule must
@@ -263,9 +289,17 @@ def _collective_matmul_target(op_name: str, schedule: str,
     if schedule == "fused":
         # the gather/scatter result may span the whole gathered payload
         exp = op_expectation(op_name, per_rank * num_ranks)
+        # resident: gathered activations (P x per-rank) + input + weight
+        # + partials — a fused schedule's peak is gather-dominated
+        exp.max_peak_bytes = int(2.5 * per_rank * num_ranks)
     else:
         # each hop carries at most one travelling per-rank chunk
         exp = overlap_op_expectation(num_ranks, per_rank)
+        # the whole point of the ring: never materialise the P x gather
+        # — input + weight + accumulator + in-flight chunks stay within
+        # a few per-rank payloads, far under the fused ceiling (XLA
+        # undoing the decomposition blows this before the kind gate)
+        exp.max_peak_bytes = 8 * per_rank
     return AuditTarget(
         name=f"comm/ops.py::{op_name}[{schedule}]",
         build=build,
@@ -297,11 +331,18 @@ def _compressed_op_target(op_name: str, compression: str,
                          dtype=jnp.bfloat16)
         return fn, (x,)
 
+    exp = compressed_op_expectation(
+        op_name, num_ranks, num_elements, compression=compression)
+    # bf16 payload + quantised wire buffers + scales; the per-peer
+    # reducescatter_q input is a [P, n] slab per rank
+    exp.max_peak_bytes = (
+        2 * num_ranks * num_elements * 2 if op_name == "reducescatter_q"
+        else 4 * num_elements * 2 + 8192
+    )
     return AuditTarget(
         name=f"comm/ops.py::{op_name}[{compression}]",
         build=build,
-        expectation=compressed_op_expectation(
-            op_name, num_ranks, num_elements, compression=compression),
+        expectation=exp,
         min_devices=num_ranks,
     )
 
@@ -335,6 +376,9 @@ def _registry_op_target(op_name: str, num_ranks: int = 8,
     else:
         ceiling = per_rank
     exp = op_expectation(op_name, ceiling)
+    # resident: input (+ the [P, n] slab for per-peer kinds), result, and
+    # a couple of masked-contribution temps — all payload-scale
+    exp.max_peak_bytes = 4 * ceiling + 8192
     return AuditTarget(
         name=f"comm/ops.py::{op_name}",
         build=build,
@@ -358,10 +402,12 @@ def _barrier_target(num_ranks: int = 8) -> AuditTarget:
         x = jnp.ones((num_ranks, 1), jnp.float32)
         return fn, (x,)
 
+    exp = op_expectation("barrier", 4)  # one f32 scalar/device
+    exp.max_peak_bytes = 8192  # scalars only — anything more is data
     return AuditTarget(
         name="comm/ops.py::barrier",
         build=build,
-        expectation=op_expectation("barrier", 4),  # one f32 scalar/device
+        expectation=exp,
         min_devices=num_ranks,
     )
 
@@ -403,6 +449,10 @@ def _tp_forward_target(dp: int = 2, tp: int = 4) -> AuditTarget:
             required_any={"all-reduce"},
             min_required=1,  # Megatron row-parallel psum (XLA may combine)
             max_bytes_per_instr=int(act_bytes * 1.25),
+            # tp-sharded weights (~n4/tp) + activations/temps; a Megatron
+            # layout collapsing to replication puts the FULL n4 resident
+            # and blows this before the all-gather even fires
+            max_peak_bytes=int(0.7 * _tiny_params_bytes()),
         ),
         min_devices=dp * tp,
     )
@@ -441,6 +491,10 @@ def _cp_forward_target(attention: str, dp: int = 2, sp: int = 4) -> AuditTarget:
             allowed=plan_expected_kinds(dp=dp, sp=sp, attention=attention),
             required_any={required},
             min_required=1,
+            # sp shards the sequence, NOT the weights: the full f32
+            # parameter set is resident per device, plus sp-sharded
+            # activations/ring buffers
+            max_peak_bytes=int(1.3 * _tiny_params_bytes()) + 65536,
         ),
         min_devices=dp * sp,
     )
@@ -497,6 +551,9 @@ def _tp_overlap_forward_target(schedule: str, dp: int = 2,
             # every ring hop must be hidden behind a partial matmul —
             # the schedule auditor's serialized-collective gate
             expect_overlap=True,
+            # same resident set as the GSPMD forward: tp-sharded weights
+            # + sequence-sharded activations + ring chunks
+            max_peak_bytes=int(0.7 * _tiny_params_bytes()),
         ),
         min_devices=dp * tp,
     )
@@ -535,13 +592,10 @@ def _tp_overlap_train_target(schedule: str, dp: int = 2,
             jnp.ones((2 * dp, 8, cfg.hidden_size), jnp.float32), sharding)
         return jit_step, (state, batch, tgt)
 
-    from dlbb_tpu.models.configs import ModelConfig
-    from dlbb_tpu.models.transformer import num_parameters
-
     # combined dp weight-grad all-reduces are bounded by the full f32
     # parameter pytree; every ring chunk and the final activation reshard
     # are far below it
-    params_bytes = num_parameters(ModelConfig(**_TINY_MODEL)) * 4
+    params_bytes = _tiny_params_bytes()
     return AuditTarget(
         name=f"train/loop.py::train_step[dp,tp,overlap={schedule}]",
         build=build,
@@ -559,6 +613,10 @@ def _tp_overlap_train_target(schedule: str, dp: int = 2,
             max_bytes_per_instr=int(params_bytes * 1.25),
             expect_donation=True,
             expect_overlap=True,
+            # tp-sharded Adam state (3 x n4/tp, donated) + grads + ring
+            # transients; a dropped donation re-adds the whole state
+            # shard and blows this first
+            max_peak_bytes=int(2.0 * params_bytes),
         ),
         min_devices=dp * tp,
     )
@@ -604,10 +662,8 @@ def _compressed_train_target(compression: str = "int8",
         op_wire_bytes,
         scale_bytes,
     )
-    from dlbb_tpu.models.configs import ModelConfig
-    from dlbb_tpu.models.transformer import num_parameters
 
-    n_params = num_parameters(ModelConfig(**_TINY_MODEL))
+    n_params = _tiny_params_bytes() // 4
     baseline = wire_bytes("all-reduce", n_params * 2, dp)  # bf16 ring AR
     # the grads ride as one flat allreduce_q-shaped reduction; the
     # ceiling is the shared contract of compression_wire_ceiling
@@ -627,6 +683,9 @@ def _compressed_train_target(compression: str = "int8",
             max_total_wire_bytes=compression_wire_ceiling(
                 baseline, analytic),
             expect_donation=True,
+            # DDP Adam state (3 x n4) + the P("dp")-sharded EF residual
+            # (~n4/device) + grads + quantise/dequantise ring buffers
+            max_peak_bytes=int(7.5 * n_params * 4),
         ),
         min_devices=dp,
     )
@@ -637,6 +696,26 @@ def _compressed_train_target(compression: str = "int8",
 # prefill bucket.  Shared by the decode and prefill targets so their
 # byte ceilings price the same cache.
 _SERVE_SHAPE = dict(max_batch=4, num_blocks=4, block_size=8, bucket=16)
+
+
+def _serve_cache_bytes_per_device(dp: int, tp: int) -> int:
+    """Analytic per-device KV-cache footprint of the serving audit
+    geometry — the SAME ``models.configs.kv_cache_bytes_per_device``
+    the build-time HBM budget gate prices, wired into the decode/prefill
+    expectations as ``donated_bytes_expected`` so the memory audit's
+    ``serving-cache-drift`` rule pins formula and compiled program to
+    each other."""
+    from dlbb_tpu.models.configs import (
+        ModelConfig,
+        kv_cache_bytes_per_device,
+    )
+
+    return kv_cache_bytes_per_device(
+        ModelConfig(**_TINY_MODEL),
+        _SERVE_SHAPE["max_batch"],
+        _SERVE_SHAPE["num_blocks"] * _SERVE_SHAPE["block_size"],
+        dp=dp, tp=tp,
+    )
 
 
 def _serve_build(dp: int, tp: int, what: str, k: int = 4):
@@ -745,6 +824,7 @@ def _decode_step_target(dp: int = 2, tp: int = 4) -> AuditTarget:
     # transfer trips.
     qkv_width = 3 * cfg_dict["hidden_size"]
     act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    cache_dev = _serve_cache_bytes_per_device(dp, tp)
     return AuditTarget(
         name="serve/engine.py::decode_step[dp,tp]",
         build=build,
@@ -754,6 +834,19 @@ def _decode_step_target(dp: int = 2, tp: int = 4) -> AuditTarget:
             min_required=1,  # row-parallel psum per scanned layer
             max_bytes_per_instr=int(act_bytes * 1.25),
             expect_donation=True,
+            # resident: tp-sharded weights + the donated cache shard +
+            # per-token activations — a cache REGATHER (the full
+            # unsharded cache materialising) adds (dp*tp - 1) x
+            # cache_dev and blows this before the byte/kind axes even
+            # report
+            max_peak_bytes=int(
+                1.3 * (_tiny_params_bytes() // tp + cache_dev)
+            ) + 16 * act_bytes,
+            # the validate_serving cross-check: the donated decode
+            # carry IS the cache (plus the [max_batch, 1, H] hidden
+            # state and the lengths vector, together <5% here) — the
+            # analytic kv_cache_bytes_per_device must match it
+            donated_bytes_expected=cache_dev,
         ),
         min_devices=dp * tp,
     )
@@ -769,6 +862,7 @@ def _prefill_target(dp: int = 2, tp: int = 4) -> AuditTarget:
         return _serve_build(dp, tp, "prefill")
 
     act_bytes = _SERVE_SHAPE["bucket"] * 3 * _TINY_MODEL["hidden_size"] * 4
+    cache_dev = _serve_cache_bytes_per_device(dp, tp)
     return AuditTarget(
         name="serve/engine.py::prefill[dp,tp]",
         build=build,
@@ -778,6 +872,11 @@ def _prefill_target(dp: int = 2, tp: int = 4) -> AuditTarget:
             min_required=1,
             max_bytes_per_instr=int(act_bytes * 1.25),
             expect_donation=True,
+            # weights + donated cache + one bucket of activations/scores
+            max_peak_bytes=int(
+                1.3 * (_tiny_params_bytes() // tp + cache_dev)
+            ) + 8 * act_bytes,
+            donated_bytes_expected=cache_dev,
         ),
         min_devices=dp * tp,
     )
@@ -801,10 +900,18 @@ def _decode_fused_target(dp: int = 2, tp: int = 4,
 
     qkv_width = 3 * _TINY_MODEL["hidden_size"]
     act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    cache_dev = _serve_cache_bytes_per_device(dp, tp)
+    exp = decode_scan_expectation(dp, tp, k, act_bytes)
+    # the fused scan carries the same donated (cache, x) as the per-step
+    # engine — K trips reuse the carry in place, so the peak must NOT
+    # scale with k
+    exp.max_peak_bytes = int(
+        1.3 * (_tiny_params_bytes() // tp + cache_dev)) + 16 * act_bytes
+    exp.donated_bytes_expected = cache_dev
     return AuditTarget(
         name=f"serve/engine.py::decode_fused[k{k},dp,tp]",
         build=build,
-        expectation=decode_scan_expectation(dp, tp, k, act_bytes),
+        expectation=exp,
         min_devices=dp * tp,
     )
 
@@ -821,6 +928,7 @@ def _prefill_chunk_target(dp: int = 2, tp: int = 4) -> AuditTarget:
 
     chunk = _SERVE_SHAPE["block_size"]
     act_bytes = chunk * 3 * _TINY_MODEL["hidden_size"] * 4
+    cache_dev = _serve_cache_bytes_per_device(dp, tp)
     return AuditTarget(
         name="serve/engine.py::prefill_chunk[dp,tp]",
         build=build,
@@ -830,6 +938,12 @@ def _prefill_chunk_target(dp: int = 2, tp: int = 4) -> AuditTarget:
             min_required=1,
             max_bytes_per_instr=int(act_bytes * 1.25),
             expect_donation=True,
+            # weights + donated cache + explicit prefix K/V carry + one
+            # chunk of activations
+            max_peak_bytes=int(
+                1.3 * (_tiny_params_bytes() // tp + cache_dev)
+            ) + 12 * act_bytes,
+            donated_bytes_expected=cache_dev,
         ),
         min_devices=dp * tp,
     )
@@ -847,10 +961,18 @@ def _compact_target(what: str, tp: int = 4) -> AuditTarget:
     def build():
         return _serve_build(1, tp, what)
 
+    exp = compact_expectation()
+    cache_dev = _serve_cache_bytes_per_device(1, tp)
+    # gather holds the full cache + the repacked half-size copy; scatter
+    # additionally donates the full carry it writes back into
+    exp.max_peak_bytes = int(
+        (2.2 if what == "compact_gather" else 2.8) * cache_dev)
+    if what == "compact_scatter":
+        exp.donated_bytes_expected = cache_dev
     return AuditTarget(
         name=f"serve/engine.py::{what}[tp]",
         build=build,
-        expectation=compact_expectation(),
+        expectation=exp,
         min_devices=tp,
     )
 
@@ -882,6 +1004,11 @@ def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
             jnp.ones((dp, 8, cfg.hidden_size), jnp.float32), sharding)
         return jit_step, (state, batch, tgt)
 
+    # resident train state: full f32 params everywhere; Adam moments
+    # replicated at ZeRO-0, dp-sharded at ZeRO-1 — plus gradients and
+    # backward transients.  A dropped donation re-adds the whole state.
+    n4 = _tiny_params_bytes()
+    peak_ceiling = int(6.5 * n4) if zero_stage == 0 else int(2.85 * n4)
     return AuditTarget(
         name=f"train/loop.py::train_step[zero{zero_stage},dp]",
         build=build,
@@ -890,6 +1017,7 @@ def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
             required_any={"all-reduce", "reduce-scatter"},
             min_required=1,  # the gradient reduction must exist
             expect_donation=True,
+            max_peak_bytes=peak_ceiling,
         ),
         min_devices=dp,
     )
@@ -974,7 +1102,7 @@ def run_hlo_audit(
     CLI's ``--simulate N`` controls the mesh."""
     import jax
 
-    if "schedule" in passes:
+    if "schedule" in passes or "memory" in passes:
         if tier is None:
             tier = default_tier()
         # resolve once, before any lowering: a mistyped --tier/--model
@@ -1012,6 +1140,8 @@ def run_hlo_audit(
         report.targets_audited.append(target.name)
         if "schedule" in _meta:
             report.schedule[target.name] = _meta["schedule"]
+        if "memory" in _meta:
+            report.memory[target.name] = _meta["memory"]
         if verbose:
             status = "FAIL" if findings else "ok"
             sched = _meta.get("schedule")
@@ -1026,6 +1156,10 @@ def run_hlo_audit(
                     f", cp {sched['critical_path_us']:.1f}us"
                     + (f", overlap {eff:.2f}" if eff is not None else "")
                 )
+            mem = _meta.get("memory")
+            if mem is not None:
+                extra += (f", peak "
+                          f"{mem['peak_live_bytes'] / 1024:.1f}KiB")
             print(f"[hlo] {target.name}: {status} "
                   f"({n_coll} collective(s){extra})")
     return report

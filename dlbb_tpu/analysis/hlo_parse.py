@@ -80,6 +80,13 @@ _CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
 _COMP_HEADER_RE = re.compile(
     r"^(?P<entry>ENTRY\s+)?%?(?P<name>[\w.\-]+)\s*(?:\(.*)?\{\s*$"
 )
+# the module header's donation table: ``input_output_alias={ {0}: (0, {},
+# may-alias), {1}: (2, {}, must-alias) }`` — output tuple index path ->
+# (parameter number, parameter index path, kind); the table span is cut
+# with _balanced_span (its entries nest braces)
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{(?P<out>[0-9,\s]*)\}:\s*\((?P<param>\d+),\s*\{(?P<pidx>[0-9,\s]*)\}"
+)
 _INSTR_START_RE = re.compile(
     r"^(?P<root>ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*(?P<rest>.+)$"
 )
@@ -123,6 +130,10 @@ class HloInstruction:
     op_name: Optional[str] = None     # jax name-stack, incl. named_scope
     trip_count: Optional[int] = None  # while only: known_trip_count
     lhs_contracting_dims: tuple[int, ...] = ()
+    # "parameter" instructions only: the entry/computation parameter
+    # number (``%p = f32[...] parameter(2)`` -> 2) — what the module's
+    # input_output_alias table keys donated buffers by
+    parameter_number: Optional[int] = None
 
     @property
     def result_bytes(self) -> int:
@@ -171,12 +182,26 @@ class HloComputation:
         return self.instructions[-1] if self.instructions else None
 
 
+@dataclass(frozen=True)
+class BufferAlias:
+    """One entry of the module's ``input_output_alias`` donation table:
+    output tuple element ``output_index`` reuses the buffer of parameter
+    ``parameter_number`` (element ``parameter_index`` when the parameter
+    is itself a tuple)."""
+
+    output_index: tuple[int, ...]
+    parameter_number: int
+    parameter_index: tuple[int, ...] = ()
+
+
 @dataclass
 class HloModule:
     """A parsed HLO module: the computation graph of one compiled program."""
 
     computations: dict[str, HloComputation] = field(default_factory=dict)
     entry: Optional[str] = None
+    # the compiled module's donation table (empty when nothing aliases)
+    input_output_alias: list[BufferAlias] = field(default_factory=list)
 
     def entry_computation(self) -> Optional[HloComputation]:
         if self.entry is not None and self.entry in self.computations:
@@ -314,6 +339,12 @@ def _parse_instruction(line: str) -> Optional[HloInstruction]:
     lhs_dims = tuple(
         int(d) for d in contract.group(1).split(",") if d
     ) if contract else ()
+    param_no = None
+    if opcode == "parameter":
+        try:
+            param_no = int(operands_text.strip())
+        except ValueError:
+            param_no = None
     return HloInstruction(
         name=m.group("name"), opcode=opcode,
         dtype=arrays[0][0] if arrays else "",
@@ -326,7 +357,29 @@ def _parse_instruction(line: str) -> Optional[HloInstruction]:
         source=f"{meta.group(1)}:{meta.group(2)}" if meta else None,
         op_name=opn.group(1) if opn else None,
         trip_count=trip, lhs_contracting_dims=lhs_dims,
+        parameter_number=param_no,
     )
+
+
+def parse_alias_table(header_line: str) -> list[BufferAlias]:
+    """The ``input_output_alias`` donation table of an ``HloModule``
+    header line (empty when the module aliases nothing)."""
+    key = "input_output_alias="
+    start = header_line.find(key)
+    if start < 0:
+        return []
+    start += len(key)
+    span = header_line[start:_balanced_span(header_line, start)]
+    out = []
+    for m in _ALIAS_ENTRY_RE.finditer(span):
+        out.append(BufferAlias(
+            output_index=tuple(
+                int(d) for d in m.group("out").split(",") if d.strip()),
+            parameter_number=int(m.group("param")),
+            parameter_index=tuple(
+                int(d) for d in m.group("pidx").split(",") if d.strip()),
+        ))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -404,7 +457,10 @@ def parse_module(hlo_text: str) -> HloModule:
     cur: Optional[HloComputation] = None
     for line in hlo_text.splitlines():
         s = line.strip()
-        if not s or s.startswith("//") or s.startswith("HloModule"):
+        if not s or s.startswith("//"):
+            continue
+        if s.startswith("HloModule"):
+            module.input_output_alias = parse_alias_table(s)
             continue
         if s.endswith("{") and _INSTR_START_RE.match(s) is None:
             m = _COMP_HEADER_RE.match(s)

@@ -1,0 +1,699 @@
+"""Pass 4 — static memory auditor (buffer liveness / peak HBM).
+
+The byte auditor proves *what* a lowered program sends over the wire,
+the schedule auditor *when* it runs — this pass proves *how much memory*
+it needs.  Over the instruction dependency graph
+(``hlo_parse.parse_module`` of the post-SPMD module, whose shapes are
+already per-device) it computes a classic buffer-liveness analysis:
+
+- every non-aliasing instruction allocates its result buffer
+  (shape x dtype summed over tuple elements); ``bitcast`` /
+  ``get-tuple-element`` / ``tuple`` are zero-cost views of their
+  operands, and a ``while`` / ``conditional`` result reuses its carry
+  / branch-root buffers (XLA's in-place loop convention), so consumers
+  of the loop keep the *carry* alive rather than a phantom copy;
+- a buffer is live from its defining instruction to its last consumer
+  (operand + control edges; scheduled HLO text order is the schedule);
+  entry parameters are live for the whole program (the caller owns
+  them), outputs from their definition to program end;
+- nested computations charge their internal peak (parameters excluded —
+  they alias the caller's operands, which are live at the call instant
+  anyway) at the call site: a while body's peak — including its root,
+  the new carry that double-buffers against the old one — is resident
+  across every trip, a conditional charges its worst branch, a fusion
+  charges only its root (fused intermediates never materialise);
+- donation is tracked through the compiled module's
+  ``input_output_alias`` table: a donated parameter stays resident to
+  program end (its buffer holds the aliased output at return) and the
+  output element it aliases is charged zero, so donated state is never
+  double-counted.
+
+Per target the pass reports ``peak_live_bytes``, the live set at the
+peak instant, and a top-N transient-buffer table, plus the
+``hbm_headroom_bytes`` / ``feasible`` term against the cost tier's
+capacity (``costmodel.hbm_headroom_bytes`` — the static OOM-pruning
+input of the future ``cli plan --auto`` search).
+
+Rules (docs/memory_audit.md):
+
+- ``peak-memory-ceiling``   — ``TargetExpectation.max_peak_bytes``
+  exceeded (the whole-program twin of the per-instruction byte gate).
+- ``unaliased-donation``    — the lowered module marks donor buffers
+  (``jax.buffer_donor`` / ``tf.aliasing_output``) but the compiled
+  module aliases fewer of them: XLA silently dropped a donation and
+  input + output state are simultaneously resident.
+- ``transient-replicated-buffer`` — on a >1-device mesh, a transient
+  intermediate at least ``num_devices`` x larger than everything that
+  feeds it AND everything that consumes it: a full-size replicated
+  buffer between sharded producer and sharded consumer (the PR-6
+  EF-residual spike, now a lint).  Collectives are exempt (a gather's
+  P x growth is its job and the wire auditor prices it); buffers under
+  ``REPLICATED_FLOOR_BYTES`` are ignored.
+- ``serving-cache-drift``   — the donated-buffer bytes disagree with
+  ``TargetExpectation.donated_bytes_expected`` beyond the tolerance:
+  the serving decode step's cache carry drifted from the analytic
+  ``kv_cache_bytes_per_device`` the build-time HBM budget gate prices.
+- ``hbm-infeasible``        — warning: the audited peak exceeds the
+  cost tier's recorded per-device capacity.
+
+Pure text/graph analysis — importable WITHOUT jax (the unit tests run
+backend-free; only the lowering in ``hlo_audit`` needs a backend).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+from dlbb_tpu.analysis.costmodel import (
+    CostTier,
+    hbm_headroom_bytes,
+    memory_feasible,
+)
+from dlbb_tpu.analysis.expectations import TargetExpectation
+from dlbb_tpu.analysis.findings import (
+    SEVERITY_ERROR,
+    SEVERITY_WARNING,
+    Finding,
+)
+from dlbb_tpu.analysis.hlo_parse import (
+    HloComputation,
+    HloModule,
+    _array_bytes,
+    parse_module,
+)
+
+# zero-cost views: the instruction's "result" is its operand's memory
+ALIAS_OPCODES = ("bitcast", "get-tuple-element", "tuple")
+# results that reuse their carry / branch-root buffers (charged at the
+# operand / in the callee's internal peak, never twice)
+CARRY_OPCODES = ("while", "conditional")
+
+# transient-replicated-buffer floor: intermediates below this are noise
+# (every default audit target's buffers are KB-scale; the rule exists
+# for the [dp, total_params]-class spikes that matter at model scale)
+REPLICATED_FLOOR_BYTES = 1 << 20
+
+# donation-marker attributes a lowered (StableHLO) module stamps on
+# donor arguments — counted against the compiled alias table
+DONOR_MARKERS = ("jax.buffer_donor", "tf.aliasing_output")
+
+# the baseline-gate slack for the memory axes lives with the diff gate:
+# schedule_audit.PEAK_MEMORY_SLACK (one contract, one constant)
+
+MEMORY_REPORT_SCHEMA = "dlbb_memory_audit_v1"
+MEMORY_REPORT_NAME = "memory_audit.json"
+
+
+# ---------------------------------------------------------------------------
+# per-computation liveness
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Buffer:
+    """One allocation root: a charged buffer with a live range."""
+
+    index: int                 # defining instruction index (-1 = param)
+    name: str
+    opcode: str
+    bytes: int                 # charged bytes (0 for aliased-away)
+    last_use: int
+    is_param: bool = False
+    parameter_number: Optional[int] = None
+    donated: bool = False      # param aliased by an output
+    aliased_output: bool = False  # output element reusing a donated param
+    source: Optional[str] = None
+
+
+@dataclass
+class _CompMem:
+    """Liveness analysis of one computation (single execution)."""
+
+    peak_bytes: int = 0            # parameters included
+    peak_extra_bytes: int = 0      # parameters excluded (call-site charge)
+    peak_index: int = 0
+    buffers: list[_Buffer] = field(default_factory=list)
+    extra_at: dict[int, int] = field(default_factory=dict)
+
+
+class _ModuleMemory:
+    """Buffer-liveness analysis over a parsed module."""
+
+    def __init__(self, module: HloModule):
+        self.module = module
+        self._memo: dict[str, _CompMem] = {}
+        # computations whose buffers never materialise on their own:
+        # fused computations (the fusion charges its root) and to_apply
+        # reducers (applied elementwise)
+        self.skipped: set[str] = set()
+        for _, instr in module.all_instructions():
+            for role, callee in instr.called:
+                if role == "to_apply" or instr.opcode == "fusion":
+                    self.skipped.add(callee)
+
+    # -- nested charge ------------------------------------------------------
+
+    def _call_extra(self, instr) -> int:
+        """Bytes a call-site instruction keeps resident beyond its own
+        result: the callee's internal peak (parameters excluded).  A
+        while alternates body and condition (max), a conditional runs
+        one branch (max — the divergence check separately pins that
+        branches agree on collectives, and memory takes the worst)."""
+        if instr.opcode == "fusion":
+            return 0
+        extra = 0
+        for role, callee in instr.called:
+            if role == "to_apply" or callee not in self.module.computations:
+                continue
+            callee_mem = self.analyze(self.module.computations[callee])
+            extra = max(extra, callee_mem.peak_extra_bytes) \
+                if instr.opcode in CARRY_OPCODES \
+                else extra + callee_mem.peak_extra_bytes
+        return extra
+
+    # -- one computation ----------------------------------------------------
+
+    def analyze(self, comp: HloComputation) -> _CompMem:
+        cached = self._memo.get(comp.name)
+        if cached is not None:
+            return cached
+        # cycle guard (invalid HLO / truncated dumps must not hang)
+        self._memo[comp.name] = _CompMem()
+
+        instrs = comp.instructions
+        n = len(instrs)
+        idx = {i.name: k for k, i in enumerate(instrs)}
+
+        # allocation roots: alias-like results point at the buffers they
+        # view (a tuple keeps ALL its elements alive through consumers)
+        roots: list[frozenset[int]] = []
+        for k, instr in enumerate(instrs):
+            aliasing = (instr.opcode in ALIAS_OPCODES
+                        or instr.opcode in CARRY_OPCODES
+                        or instr.is_done)
+            if aliasing and instr.operands:
+                s: set[int] = set()
+                for o in instr.operands:
+                    j = idx.get(o)
+                    if j is not None and j < k:
+                        s |= roots[j]
+                roots.append(frozenset(s) if s else frozenset({k}))
+            else:
+                roots.append(frozenset({k}))
+
+        def charged(k: int) -> int:
+            instr = instrs[k]
+            if (instr.opcode in ALIAS_OPCODES
+                    or instr.opcode in CARRY_OPCODES or instr.is_done):
+                return 0
+            return instr.result_bytes
+
+        buffers: dict[int, _Buffer] = {}
+        for k, instr in enumerate(instrs):
+            if k not in roots[k]:
+                continue  # pure alias, never an allocation root
+            buffers[k] = _Buffer(
+                index=-1 if instr.opcode == "parameter" else k,
+                name=instr.name,
+                opcode=instr.opcode,
+                bytes=charged(k),
+                last_use=k,
+                is_param=instr.opcode == "parameter",
+                parameter_number=instr.parameter_number,
+                source=instr.source,
+            )
+
+        # live ranges: last consumer over operand + control edges
+        for k, instr in enumerate(instrs):
+            for o in (*instr.operands, *instr.control_deps):
+                j = idx.get(o)
+                if j is None:
+                    continue
+                for r in roots[j]:
+                    if r in buffers:
+                        buffers[r].last_use = max(buffers[r].last_use, k)
+        root_instr = comp.root
+        if root_instr is not None:
+            for r in roots[idx[root_instr.name]]:
+                if r in buffers:
+                    buffers[r].last_use = n  # output: live through end
+        for b in buffers.values():
+            if b.is_param:
+                b.last_use = n  # caller-owned: resident the whole run
+
+        mem = _CompMem(buffers=sorted(buffers.values(),
+                                      key=lambda b: max(b.index, 0)))
+        mem.extra_at = {
+            k: self._call_extra(instr)
+            for k, instr in enumerate(instrs) if instr.called
+        }
+        self._memo[comp.name] = mem
+        self._sweep(mem, n)
+        return mem
+
+    @staticmethod
+    def _sweep(mem: _CompMem, n: int) -> None:
+        """Peak over the schedule: at each instruction instant, the sum
+        of live charged buffers plus the instant's nested extra."""
+        if n == 0:
+            return
+        delta = [0] * (n + 1)
+        base = 0
+        delta_np = [0] * (n + 1)   # parameters excluded
+        base_np = 0
+        for b in mem.buffers:
+            lo = b.index
+            hi = min(b.last_use, n - 1)
+            if lo < 0:
+                base += b.bytes
+                if not b.is_param:
+                    base_np += b.bytes
+                lo = 0
+            else:
+                delta[lo] += b.bytes
+                if not b.is_param:
+                    delta_np[lo] += b.bytes
+            if hi + 1 <= n:
+                delta[hi + 1] -= b.bytes
+                if not b.is_param:
+                    delta_np[hi + 1] -= b.bytes
+        live, live_np = base, base_np
+        for k in range(n):
+            live += delta[k]
+            live_np += delta_np[k]
+            extra = mem.extra_at.get(k, 0)
+            if live + extra > mem.peak_bytes:
+                mem.peak_bytes = live + extra
+                mem.peak_index = k
+            mem.peak_extra_bytes = max(mem.peak_extra_bytes,
+                                       live_np + extra)
+
+
+# ---------------------------------------------------------------------------
+# the memory pass (per audit target)
+# ---------------------------------------------------------------------------
+
+
+def _count_donor_markers(lowered_text: str) -> int:
+    return sum(lowered_text.count(marker) for marker in DONOR_MARKERS)
+
+
+def _apply_donation(module: HloModule, entry: HloComputation,
+                    mem: _CompMem) -> list[dict]:
+    """Mark donated parameters and zero-charge the output elements that
+    reuse their buffers (the donated region is occupied once, for the
+    whole program).  Returns the donated-parameter records."""
+    donated_numbers = {a.parameter_number
+                       for a in module.input_output_alias}
+    by_param = {b.parameter_number: b for b in mem.buffers if b.is_param}
+    by_name = {b.name: b for b in mem.buffers}
+    root = entry.root
+    idx = {i.name: k for k, i in enumerate(entry.instructions)}
+    for alias in module.input_output_alias:
+        p = by_param.get(alias.parameter_number)
+        if p is not None:
+            p.donated = True
+        # the output element reusing the donated region: charged zero
+        target = root
+        if (root is not None and root.opcode == "tuple"
+                and alias.output_index
+                and alias.output_index[0] < len(root.operands)):
+            j = idx.get(root.operands[alias.output_index[0]])
+            target = entry.instructions[j] if j is not None else None
+        if target is None:
+            continue
+        # follow alias chains to the allocation root(s); zero the first
+        # non-parameter one (a param pass-through keeps its param charge)
+        stack = [target.name]
+        seen: set[str] = set()
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            b = by_name.get(name)
+            if b is not None and not b.is_param and not b.aliased_output:
+                b.aliased_output = True
+                b.bytes = 0
+            elif b is None and name in idx:
+                for o in entry.instructions[idx[name]].operands:
+                    stack.append(o)
+    return [
+        {
+            "name": b.name,
+            "parameter_number": b.parameter_number,
+            "bytes": b.bytes,
+            "aliased": b.donated,
+        }
+        for b in mem.buffers if b.is_param
+        and (b.donated or donated_numbers)
+    ]
+
+
+def _transients(analysis: _ModuleMemory,
+                top_n: int) -> tuple[list[dict], int]:
+    """Charged, non-parameter buffers that die before their computation
+    ends — the intermediates XLA's temp allocation must hold — across
+    every materialising computation, largest first."""
+    rows: list[dict] = []
+    for name, comp in analysis.module.computations.items():
+        if name in analysis.skipped:
+            continue
+        mem = analysis.analyze(comp)
+        end = len(comp.instructions)
+        for b in mem.buffers:
+            if b.is_param or b.bytes <= 0 or b.last_use >= end:
+                continue
+            rows.append({
+                "name": b.name,
+                "opcode": b.opcode,
+                "bytes": b.bytes,
+                "computation": name,
+                "execution_count": comp.execution_count,
+                "source": b.source,
+            })
+    rows.sort(key=lambda r: (-r["bytes"], r["name"]))
+    max_bytes = rows[0]["bytes"] if rows else 0
+    return rows[:top_n], max_bytes
+
+
+def _check_replicated(analysis: _ModuleMemory, num_devices: int,
+                      target: str, findings: list[Finding],
+                      floor: int = REPLICATED_FLOOR_BYTES) -> None:
+    if num_devices <= 1:
+        return
+    for cname, comp in analysis.module.computations.items():
+        if cname in analysis.skipped:
+            continue
+        instrs = comp.instructions
+        idx = {i.name: k for k, i in enumerate(instrs)}
+        consumers: dict[int, list[int]] = {}
+        for k, instr in enumerate(instrs):
+            for o in instr.operands:
+                j = idx.get(o)
+                if j is not None:
+                    consumers.setdefault(j, []).append(k)
+        end = len(instrs)
+        mem = analysis.analyze(comp)
+        by_index = {b.index: b for b in mem.buffers}
+        for k, instr in enumerate(instrs):
+            b = by_index.get(k)
+            if (b is None or b.is_param or b.bytes < floor
+                    or b.last_use >= end or instr.kind is not None):
+                continue
+            if not instr.operand_arrays:
+                # constants/iota materialise from nothing — "P x larger
+                # than every operand" is vacuous there, and a baked
+                # weight table must never trip an error finding
+                continue
+            max_operand = max(
+                _array_bytes(d, s) for d, s in instr.operand_arrays
+            )
+            if max_operand * num_devices > b.bytes:
+                continue  # producer not sharded relative to this buffer
+            shrunk = [
+                instrs[c] for c in consumers.get(k, ())
+                if instrs[c].result_bytes * num_devices <= b.bytes
+            ]
+            if not shrunk:
+                continue
+            findings.append(Finding(
+                pass_name="memory",
+                rule="transient-replicated-buffer",
+                severity=SEVERITY_ERROR,
+                target=target,
+                message=(
+                    f"{instr.opcode} {instr.name} materialises "
+                    f"{b.bytes} B/device — at least {num_devices}x "
+                    f"every operand that feeds it and consumer "
+                    f"{shrunk[0].name} shrinks it back by the same "
+                    "factor: a full-size replicated intermediate "
+                    "between sharded producer and consumer (the "
+                    "transient HBM spike class); create the value "
+                    "directly under its target sharding (jit "
+                    "out-shardings / sharding constraint) instead of "
+                    "materialising the replicated copy"
+                ),
+                location=instr.source,
+                details={
+                    "name": instr.name,
+                    "opcode": instr.opcode,
+                    "bytes": b.bytes,
+                    "max_operand_bytes": max_operand,
+                    "num_devices": num_devices,
+                    "computation": cname,
+                    "shrinking_consumers": [i.name for i in shrunk],
+                },
+            ))
+
+
+def analyze_memory(
+    hlo: "str | HloModule",
+    expectation: TargetExpectation,
+    target: str,
+    lowered_text: str = "",
+    num_devices: int = 1,
+    tier: Optional[CostTier] = None,
+    top_n: int = 8,
+) -> tuple[list[Finding], dict]:
+    """Run the buffer-liveness memory audit over one compiled module.
+    Returns the findings plus the per-target memory meta (the JSON-report
+    / baseline payload)."""
+    module = hlo if isinstance(hlo, HloModule) else parse_module(hlo)
+    findings: list[Finding] = []
+    analysis = _ModuleMemory(module)
+    entry = module.entry_computation()
+    if entry is None:
+        return findings, {"peak_live_bytes": 0}
+
+    mem = analysis.analyze(entry)
+    donated_params = _apply_donation(module, entry, mem)
+    # donation rewrites buffer charges: re-sweep the entry
+    mem.peak_bytes = mem.peak_extra_bytes = 0
+    analysis._sweep(mem, len(entry.instructions))
+
+    end = len(entry.instructions)
+    param_bytes = sum(b.bytes for b in mem.buffers if b.is_param)
+    donated_bytes = sum(b.bytes for b in mem.buffers
+                        if b.is_param and b.donated)
+    # output buffers: the only non-parameter allocations living through
+    # program end (donated-aliased elements were zero-charged above)
+    output_bytes = sum(
+        b.bytes for b in mem.buffers
+        if not b.is_param and b.last_use >= end and b.bytes > 0
+    )
+
+    # live set at the peak instant
+    peak_k = mem.peak_index
+    live_at_peak = sorted(
+        (
+            {"name": b.name, "opcode": b.opcode, "bytes": b.bytes}
+            for b in mem.buffers
+            if b.bytes > 0 and b.index <= peak_k <= b.last_use
+        ),
+        key=lambda r: (-r["bytes"], r["name"]),
+    )
+    top_transients, max_transient = _transients(analysis, top_n)
+
+    meta: dict[str, Any] = {
+        "peak_live_bytes": int(mem.peak_bytes),
+        "peak_instruction": (
+            entry.instructions[peak_k].name
+            if 0 <= peak_k < end else None
+        ),
+        "parameter_bytes": int(param_bytes),
+        "donated_param_bytes": int(donated_bytes),
+        "output_bytes": int(output_bytes),
+        "num_buffers": sum(
+            1 for b in mem.buffers if b.bytes > 0 or b.is_param
+        ),
+        "donated_params": donated_params,
+        "live_at_peak": live_at_peak[:top_n],
+        "top_transients": top_transients,
+        "max_transient_bytes": int(max_transient),
+    }
+    if tier is not None:
+        headroom = hbm_headroom_bytes(mem.peak_bytes, tier)
+        meta["hbm_bytes"] = int(tier.hbm_bytes) or None
+        meta["hbm_headroom_bytes"] = headroom
+        meta["feasible"] = memory_feasible(mem.peak_bytes, tier)
+        if meta["feasible"] is False:
+            findings.append(Finding(
+                pass_name="memory", rule="hbm-infeasible",
+                severity=SEVERITY_WARNING, target=target,
+                message=(
+                    f"audited peak {mem.peak_bytes} B/device exceeds the "
+                    f"{tier.name} tier's recorded capacity of "
+                    f"{int(tier.hbm_bytes)} B — this program OOMs on "
+                    "that hardware; a plan search must prune it"
+                ),
+                details={"peak_live_bytes": mem.peak_bytes,
+                         "hbm_bytes": int(tier.hbm_bytes)},
+            ))
+
+    # -- rules --------------------------------------------------------------
+
+    if (expectation.max_peak_bytes is not None
+            and mem.peak_bytes > expectation.max_peak_bytes):
+        findings.append(Finding(
+            pass_name="memory", rule="peak-memory-ceiling",
+            severity=SEVERITY_ERROR, target=target,
+            message=(
+                f"peak live bytes {mem.peak_bytes} B/device exceed the "
+                f"plan ceiling of {expectation.max_peak_bytes} B — the "
+                "lowered program keeps more resident than the analytic "
+                "model (params + state + activations + cache) accounts "
+                "for; inspect live_at_peak/top_transients for the "
+                "buffer the plan does not know about"
+            ),
+            details={
+                "peak_live_bytes": int(mem.peak_bytes),
+                "max_peak_bytes": expectation.max_peak_bytes,
+                "live_at_peak": live_at_peak[:top_n],
+            },
+        ))
+
+    donors = _count_donor_markers(lowered_text)
+    aliased = sum(1 for p in donated_params if p["aliased"])
+    # the contract can demand donation even when the lowered text is
+    # unavailable (or the donor marker never made it in): at least one
+    # aliased buffer must exist on an expect_donation target
+    expected_donors = donors or (1 if expectation.expect_donation else 0)
+    if expected_donors and aliased < expected_donors:
+        findings.append(Finding(
+            pass_name="memory", rule="unaliased-donation",
+            severity=SEVERITY_ERROR, target=target,
+            message=(
+                f"{donors} donor marker(s) in the lowered module "
+                f"(expectation demands >= {expected_donors}) but the "
+                f"compiled module aliases only {aliased} — the donation "
+                "was dropped (layout/sharding mismatch between the "
+                "donated input and its output, or a missing "
+                "donate_argnums), so input AND output state stay "
+                "simultaneously resident; the donated buffer's live "
+                "range runs to program end without an aliased output "
+                "reusing it"
+            ),
+            details={
+                "donor_markers": donors,
+                "aliased_parameters": aliased,
+                "donated_params": donated_params,
+            },
+        ))
+
+    _check_replicated(analysis, num_devices, target, findings)
+
+    if expectation.donated_bytes_expected is not None:
+        expected = expectation.donated_bytes_expected
+        tol = expectation.donated_bytes_tolerance
+        if abs(donated_bytes - expected) > tol * expected:
+            findings.append(Finding(
+                pass_name="memory", rule="serving-cache-drift",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"donated input buffers sum to {donated_bytes} "
+                    f"B/device but the analytic model (validate_serving's "
+                    f"kv_cache_bytes_per_device) prices {expected} B "
+                    f"(tolerance {tol:.0%}) — the build-time HBM budget "
+                    "gate and the compiled program disagree about the "
+                    "cache footprint; fix whichever drifted and re-pin"
+                ),
+                details={
+                    "donated_param_bytes": int(donated_bytes),
+                    "expected_bytes": expected,
+                    "tolerance": tol,
+                    "donated_params": donated_params,
+                },
+            ))
+        meta["analytic_donated_bytes"] = expected
+    return findings, meta
+
+
+# ---------------------------------------------------------------------------
+# manifest / Prometheus surface (`analyze memory --output DIR`)
+# ---------------------------------------------------------------------------
+
+
+def memory_metrics(memory: dict[str, dict], tier: Optional[CostTier] = None,
+                   registry=None):
+    """The memory audit as Prometheus gauges — one
+    ``analysis_peak_live_bytes{target=...}`` sample per audited target
+    (plus headroom where the tier records capacity), folded into the
+    same ``metrics.prom`` the calibration gauges land in so memory
+    regressions show up next to cost-model health on a scrape
+    dashboard."""
+    from dlbb_tpu.obs.export import MetricsRegistry
+
+    registry = registry or MetricsRegistry()
+    tier_label = tier.name if tier is not None else "unknown"
+    for target in sorted(memory):
+        meta = memory[target]
+        registry.set_gauge(
+            "analysis_peak_live_bytes", meta.get("peak_live_bytes", 0),
+            help="statically audited per-device peak live bytes "
+                 "(buffer-liveness pass)",
+            target=target, tier=tier_label,
+        )
+        headroom = meta.get("hbm_headroom_bytes")
+        if headroom is not None:
+            registry.set_gauge(
+                "analysis_hbm_headroom_bytes", headroom,
+                help="tier capacity minus audited peak",
+                target=target, tier=tier_label,
+            )
+    registry.set_gauge("analysis_memory_targets", len(memory),
+                       help="targets the memory audit covered",
+                       tier=tier_label)
+    return registry
+
+
+def write_memory_artifacts(memory: dict[str, dict], out_dir: "str | Path",
+                           tier: Optional[CostTier] = None) -> Path:
+    """Write the per-target memory report under ``out_dir`` and surface
+    it where runtime health already lives: the audit aggregate (peak
+    per target + the pricing tier) merges into the directory's
+    ``sweep_manifest.json`` and the gauges fold into ``metrics.prom``
+    without clobbering a co-located sweep/serving export."""
+    from dlbb_tpu.obs.calibration import METRICS_NAME, _fold_metrics
+    from dlbb_tpu.utils.config import atomic_write_text, save_json
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    report = {
+        "schema": MEMORY_REPORT_SCHEMA,
+        "tier": tier.name if tier is not None else None,
+        "cost_model_version": tier.version if tier is not None else None,
+        "targets": memory,
+        "timestamp": time.time(),
+    }
+    path = atomic_write_text(
+        json.dumps(report, indent=2, sort_keys=True),
+        out_dir / MEMORY_REPORT_NAME,
+    )
+
+    from dlbb_tpu.bench.schedule import MANIFEST_NAME, MANIFEST_SCHEMA
+
+    manifest_path = out_dir / MANIFEST_NAME
+    manifest: dict[str, Any] = {"schema": MANIFEST_SCHEMA,
+                                "kind": "memory-audit"}
+    if manifest_path.exists():
+        try:
+            manifest = json.loads(manifest_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            pass  # torn/legacy manifest: rewrite with the audit only
+    manifest["memory_audit"] = {
+        "tier": tier.name if tier is not None else None,
+        "cost_model_version": tier.version if tier is not None else None,
+        "targets_audited": len(memory),
+        "peak_live_bytes": {
+            t: memory[t].get("peak_live_bytes") for t in sorted(memory)
+        },
+    }
+    manifest.setdefault("timestamp", time.time())
+    save_json(manifest, manifest_path)
+    _fold_metrics(memory_metrics(memory, tier), out_dir / METRICS_NAME)
+    return path

@@ -82,6 +82,9 @@ QRING_HOP_MARK = "qring_hop"
 # baseline-gate thresholds: growth beyond these fails `analyze diff`
 CRITICAL_PATH_SLACK = 1.10
 WIRE_SLACK = 1.10
+# memory axis (the buffer-liveness pass, memory_audit.py): same 10 %
+# contract on peak live bytes and on the largest transient buffer
+PEAK_MEMORY_SLACK = 1.10
 
 
 # ---------------------------------------------------------------------------
@@ -553,12 +556,14 @@ def analyze_schedule(
 
 DEFAULT_BASELINE_DIR = Path("stats/analysis/baselines")
 
-# keys of the schedule meta that are snapshotted and diffed
+# keys of the schedule meta that are snapshotted and diffed (the
+# peak_live_bytes / max_transient_bytes pair is folded in from the
+# memory pass by analysis.run_analysis — one gate file per target)
 _BASELINE_KEYS = (
     "cost_model_version", "tier", "critical_path_us",
     "comm_on_critical_path_us", "comm_total_us", "compute_total_us",
     "overlap_efficiency", "total_wire_bytes", "num_collectives",
-    "collective_kinds",
+    "collective_kinds", "peak_live_bytes", "max_transient_bytes",
 )
 
 
@@ -689,6 +694,10 @@ def diff_baselines(
             ("critical_path_us", CRITICAL_PATH_SLACK,
              "critical-path-regression"),
             ("total_wire_bytes", WIRE_SLACK, "wire-volume-regression"),
+            ("peak_live_bytes", PEAK_MEMORY_SLACK,
+             "peak-memory-regression"),
+            ("max_transient_bytes", PEAK_MEMORY_SLACK,
+             "transient-buffer-regression"),
         ):
             b, c = base.get(key), cur.get(key)
             if not b or c is None:
@@ -700,13 +709,17 @@ def diff_baselines(
                     message=(
                         f"{key} grew {c / b:.2f}x over the committed "
                         f"baseline ({b} -> {c}, gate at {slack:.2f}x) — "
-                        "unexplained schedule regression; investigate, "
-                        "then re-snapshot if the growth is intended"
+                        "unexplained "
+                        + ("memory" if "bytes" in key
+                           and "wire" not in key else "schedule")
+                        + " regression; investigate, then re-snapshot "
+                        "if the growth is intended"
                     ),
                     details={"key": key, "baseline": b, "current": c,
                              "ratio": round(c / b, 4)},
                 ))
-            elif c < b / slack and key == "critical_path_us":
+            elif c < b / slack and key in ("critical_path_us",
+                                           "peak_live_bytes"):
                 findings.append(Finding(
                     pass_name="schedule", rule="baseline-improved",
                     severity=SEVERITY_WARNING, target=target,

@@ -18,6 +18,23 @@ distributed benchmark repo cares about and generic linters do not:
   therefore a fresh trace + compile (the Python-scalar-capture recompile
   hazard).  Warning severity (a name-resolution heuristic); CI runs with
   ``--strict-warnings`` so it still gates.
+- ``host-transfer-in-loop``: ``np.asarray(...)`` / ``jax.device_get`` /
+  ``.block_until_ready`` inside a Python loop body — the host-side twin
+  of ``jit-in-loop``: a per-iteration device->host transfer (or full
+  pipeline sync) serialises dispatch into every trip and scales with the
+  loop, exactly the round-trip the fused-decode fast path exists to
+  eliminate.  Warning severity (argument size is not statically
+  knowable); CI runs ``--strict-warnings`` so it still gates.  Exempt:
+  the measurement API homes (``TIMING_API_FILES`` +
+  ``PROFILER_API_FILES`` — bracketed syncs around measurement are their
+  whole purpose), calls inside a *timed region* (the timed-region rules
+  own that domain and its bracketing-sync convention), loops over a
+  constant literal tuple/list (a bounded probe ladder, not a data
+  loop), and calls on a loop-exit path (an ``if`` body ending in
+  ``break``/``return``/``raise`` executes at most once).  Only the loop
+  BODY is walked (the iter expression evaluates once, a ``for/else``
+  clause runs once) and nested function/lambda definitions are skipped
+  (defined inside the loop is not executed per iteration).
 - ``unsorted-set-iteration``: a ``for`` statement iterating directly over
   a set literal / ``set(...)`` call — hash-order dependent, so publish
   scripts reprocess artifacts in a different order run to run (the
@@ -79,6 +96,7 @@ LINT_RULES = (
     "profiler-in-timed-region",
     "missing-donation",
     "jit-in-loop",
+    "host-transfer-in-loop",
     "unsorted-set-iteration",
     "non-atomic-artifact-write",
 )
@@ -115,6 +133,11 @@ _WALLCLOCK_NAMES = {
 _PROFILER_CALL_NAMES = {
     "maybe_trace", "annotate", "step_annotation", "capture_device_trace",
 }
+# per-iteration device->host transfers the in-loop rule flags: the
+# named trio only (float()/int() scalarisation of a device scalar moves
+# 4 bytes and is the sanctioned way OUT of this finding; jnp.asarray is
+# device-side and exempt by the np/numpy prefix check)
+_HOST_TRANSFER_CALLS = {"block_until_ready", "device_get"}
 
 
 def _is_profiler_call(name: str) -> bool:
@@ -227,6 +250,77 @@ def _profiler_calls(stmt: ast.stmt) -> Iterable[tuple[ast.Call, str]]:
         if isinstance(node, ast.Call) and _is_profiler_call(
                 _call_name(node)):
             yield node, f"{_call_name(node)}()"
+
+
+def _walk_skip_defs(node: ast.AST) -> Iterable[ast.AST]:
+    """``ast.walk`` that does not descend into nested function/lambda
+    definitions — code *defined* inside a loop body is not necessarily
+    *executed* per iteration."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        yield n
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _host_transfer_calls(node: ast.AST) -> Iterable[tuple[ast.Call, str]]:
+    """(call, description) for every device->host transfer/sync call
+    inside ``node`` (nested defs excluded): ``*.block_until_ready`` /
+    ``jax.device_get`` / ``np.asarray`` (numpy's ``asarray`` on a
+    device array pulls the whole buffer to host; ``jnp.asarray`` stays
+    on device and is not matched)."""
+    for n in _walk_skip_defs(node):
+        if not isinstance(n, ast.Call):
+            continue
+        name = _call_name(n)
+        short = name.rsplit(".", 1)[-1]
+        if short in _HOST_TRANSFER_CALLS:
+            yield n, name
+        elif short == "asarray" and name.split(".")[0] in ("np", "numpy"):
+            yield n, name
+
+
+def _timed_line_spans(tree: ast.AST) -> list[tuple[int, int]]:
+    """Line spans of every syntactic timed region — Timer with-blocks
+    and ``t = perf_counter()`` ... ``perf_counter() - t`` spans — so
+    rules that defer to the timed-region rules (their bracketing-sync
+    convention is policed there) can skip them."""
+    spans: list[tuple[int, int]] = []
+    for node in _timed_with_blocks(tree):
+        spans.append((node.lineno, node.end_lineno or node.lineno))
+    for scope in ast.walk(tree):
+        body = getattr(scope, "body", None)
+        if not isinstance(body, list):
+            continue
+        for blk in (body, getattr(scope, "orelse", None),
+                    getattr(scope, "finalbody", None)):
+            if not isinstance(blk, list):
+                continue
+            svars: dict[str, int] = {}
+            for idx, stmt in enumerate(blk):
+                if (isinstance(stmt, ast.Assign)
+                        and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and _is_perf_counter_call(stmt.value)):
+                    svars[stmt.targets[0].id] = idx
+                    continue
+                closed = set()
+                for node in ast.walk(stmt):
+                    if (isinstance(node, ast.BinOp)
+                            and isinstance(node.op, ast.Sub)
+                            and _is_perf_counter_call(node.left)
+                            and isinstance(node.right, ast.Name)
+                            and node.right.id in svars):
+                        closed.add(node.right.id)
+                for var in closed:
+                    start = svars.pop(var)
+                    spans.append((blk[start].lineno,
+                                  stmt.end_lineno or stmt.lineno))
+    return spans
 
 
 # ---------------------------------------------------------------------------
@@ -485,6 +579,67 @@ def _check_jit_in_loop(tree: ast.AST, path: str, findings: list[Finding]):
                 ))
 
 
+def _is_constant_iterable(node: ast.AST) -> bool:
+    """A literal tuple/list of constants — a bounded probe ladder
+    (``for mode in ("head", "whole")``), not a data loop."""
+    return (isinstance(node, (ast.Tuple, ast.List))
+            and all(isinstance(e, ast.Constant) for e in node.elts))
+
+
+def _check_host_transfer_in_loop(tree: ast.AST, path: str,
+                                 findings: list[Finding]):
+    """``host-transfer-in-loop``: a device->host transfer repeated every
+    iteration of a Python loop (the host-side twin of jit-in-loop).
+    Exempt spans: timed regions (the timed-region rules own those and
+    their bracketing-sync convention), constant-literal probe loops, and
+    loop-exit ``if`` bodies (break/return/raise — at most one
+    execution)."""
+    exempt = _timed_line_spans(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.For) and _is_constant_iterable(node.iter):
+            exempt.append((node.lineno, node.end_lineno or node.lineno))
+        elif (isinstance(node, ast.If) and node.body
+                and isinstance(node.body[-1],
+                               (ast.Break, ast.Return, ast.Raise))):
+            last = node.body[-1]
+            exempt.append((node.body[0].lineno,
+                           last.end_lineno or last.lineno))
+    seen: set[tuple[int, int]] = set()
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.While)):
+            continue
+        if isinstance(loop, ast.For) and _is_constant_iterable(loop.iter):
+            continue
+        # the loop BODY only: the iter expression evaluates once, and a
+        # for/else clause runs once after the loop
+        for stmt in loop.body:
+            for call, desc in _host_transfer_calls(stmt):
+                key = (call.lineno, call.col_offset)
+                if key in seen:
+                    continue  # nested loops re-discover the same call
+                if any(lo <= call.lineno <= hi for lo, hi in exempt):
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    pass_name="lint",
+                    rule="host-transfer-in-loop",
+                    severity=SEVERITY_WARNING,
+                    target=path,
+                    message=(
+                        f"{desc}() inside a loop body forces a "
+                        "device->host round trip (or full pipeline "
+                        "sync) EVERY iteration — dispatch serialises "
+                        "into each trip and the cost scales with the "
+                        "loop; batch the transfer outside the loop, "
+                        "keep the reduction on device (e.g. jnp.argmax "
+                        "+ a scalar int()), or fuse the steps into one "
+                        "dispatch (docs/serving.md fast path)"
+                    ),
+                    location=f"{path}:{call.lineno}",
+                    details={"call": desc, "loop_line": loop.lineno},
+                ))
+
+
 def _check_atomic_writes(tree: ast.AST, path: str, findings: list[Finding]):
     """``non-atomic-artifact-write``: JSON artifacts must go through the
     atomic helper (tmp + fsync + ``os.replace``), never be written
@@ -589,6 +744,11 @@ def lint_source(source: str, path: str) -> tuple[list[Finding], int]:
                               check_profiler=check_prof)
         _check_perf_counter_regions(tree, path, findings,
                                     check_profiler=check_prof)
+        if check_prof:
+            # the measurement/capture API homes drive the device in
+            # loops on purpose (timing reps, profile reps) — same
+            # exemption set as the profiler rule
+            _check_host_transfer_in_loop(tree, path, findings)
     _check_donation(tree, path, findings)
     _check_jit_in_loop(tree, path, findings)
     _check_set_iteration(tree, path, findings)

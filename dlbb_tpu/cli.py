@@ -156,14 +156,16 @@ def build_parser() -> argparse.ArgumentParser:
              "1 findings / 2 crash (docs/schedule_audit.md)",
     )
     an.add_argument("which", nargs="?", default="all",
-                    choices=("hlo", "lint", "schedule", "all",
+                    choices=("hlo", "lint", "schedule", "memory", "all",
                              "snapshot", "diff"),
                     help="pass to run: hlo = collective byte audit, "
                          "schedule = α–β critical-path/overlap audit, "
+                         "memory = buffer-liveness peak-HBM audit, "
                          "lint = AST source lint, all = every pass "
-                         "(default); snapshot = (re)write the schedule "
-                         "regression baselines, diff = fail on "
-                         "unexplained drift from the committed baselines")
+                         "(default); snapshot = (re)write the "
+                         "regression baselines (schedule + memory "
+                         "axes), diff = fail on unexplained drift from "
+                         "the committed baselines")
     an.add_argument("--simulate", type=int, default=0, metavar="N",
                     help="use an N-device CPU-simulated mesh for the HLO "
                          "audit (targets needing more devices than "
@@ -188,6 +190,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "fitted from the sweep corpus "
                          "(stats/analysis/costmodel_fit/; falls back to "
                          "cm1 with a fit-missing warning)")
+    an.add_argument("--output", default=None, metavar="DIR",
+                    help="observability surface for the memory audit: "
+                         "write memory_audit.json under DIR, merge the "
+                         "per-target peak_live_bytes (+ audit tier) "
+                         "into DIR/sweep_manifest.json, and fold "
+                         "analysis_peak_live_bytes{target} gauges into "
+                         "DIR/metrics.prom (docs/memory_audit.md)")
 
     ob = sub.add_parser(
         "obs",
@@ -705,6 +714,7 @@ def _dispatch(args) -> int:
             which=args.which, root=args.root, json_path=args.json,
             strict_warnings=args.strict_warnings,
             baselines=args.baselines, tier=args.tier, model=args.model,
+            output=args.output,
         )
 
     if args.cmd == "obs":

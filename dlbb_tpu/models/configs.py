@@ -267,14 +267,40 @@ SERVABLE_ATTENTION = ("full", "dense")
 _DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
 
 
+def kv_cache_bytes_raw(num_layers: int, max_batch: int, max_seq: int,
+                       kv_heads: int, head_dim: int,
+                       dtype: str = "bfloat16") -> int:
+    """The one KV-cache footprint formula, on raw geometry (for callers
+    holding a serialized model record instead of a ModelConfig — e.g.
+    ``obs/attribution.py`` pricing a run's report): K + V, every layer,
+    every slot, ``max_seq`` tokens at GQA ``kv_heads`` width."""
+    return (2 * num_layers * max_batch * max_seq * kv_heads * head_dim
+            * _DTYPE_BYTES.get(dtype, 2))
+
+
 def kv_cache_bytes(config: ModelConfig, max_batch: int,
                    max_seq: int) -> int:
     """Total (unsharded) KV-cache footprint of a serving config: K + V,
     every layer, every slot, ``max_seq`` tokens at GQA ``kv_heads``
     width, in the model dtype."""
-    return (2 * config.num_layers * max_batch * max_seq
-            * config.kv_heads * config.head_dim
-            * _DTYPE_BYTES[config.dtype])
+    return kv_cache_bytes_raw(config.num_layers, max_batch, max_seq,
+                              config.kv_heads, config.head_dim,
+                              config.dtype)
+
+
+def kv_cache_bytes_per_device(config: ModelConfig, max_batch: int,
+                              max_seq: int, dp: int = 1,
+                              tp: int = 1) -> int:
+    """Per-device KV-cache footprint under the serving sharding contract
+    (slot dim over dp, kv-head dim over tp) — the ONE number both the
+    build-time HBM budget gate (``validate_serving``) and the static
+    memory audit's decode-step cross-check
+    (``analysis/memory_audit.py``, rule ``serving-cache-drift``) price,
+    so the two can never drift apart: the audit pins this formula
+    against the donated cache-carry bytes of the compiled decode
+    program."""
+    shards = max(1, dp) * (tp if tp > 1 else 1)
+    return kv_cache_bytes(config, max_batch, max_seq) // shards
 
 
 def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
@@ -328,9 +354,8 @@ def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
             "kv_heads % tp == 0 (pick a smaller tp or more kv heads)"
         )
     if hbm_budget_bytes is not None:
-        total = kv_cache_bytes(config, max_batch, max_seq)
-        shards = max(1, dp) * (tp if tp > 1 else 1)
-        per_device = total // shards
+        per_device = kv_cache_bytes_per_device(
+            config, max_batch, max_seq, dp=dp, tp=tp)
         if per_device > hbm_budget_bytes:
             raise ValueError(
                 f"serving KV-cache footprint {per_device / 2**30:.2f} GiB "

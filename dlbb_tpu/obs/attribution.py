@@ -149,6 +149,16 @@ def _infer_tier(input_dir: Path) -> str:
     return "cpu-sim"
 
 
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit, div in (("GiB", 2**30), ("MiB", 2**20), ("KiB", 2**10)):
+        if n >= div:
+            return f"{n / div:.1f} {unit}"
+    return f"{int(n)} B"
+
+
 def _span_phase(name: str) -> Optional[str]:
     phase = _SPAN_PHASE.get(name)
     if phase:
@@ -306,6 +316,44 @@ def _serving_dispatch_features(report: dict[str, Any]
     }
 
 
+def _serving_peak_bytes(report: dict[str, Any]) -> dict[str, int]:
+    """Static per-device peak-memory prediction per serving phase, from
+    the report's model/serving/mesh records — the memory-audit twin of
+    the time prediction: tp-sharded weights (~12·L·H² magnitude
+    estimate) + the dp/tp-sharded KV cache (priced by the ONE formula,
+    ``models.configs.kv_cache_bytes_raw`` — the same number the HBM
+    budget gate and the static cache cross-check use) + phase
+    activations.  Empty (the column stays honest-blank) when the run
+    records no model/serving geometry — sweep runs, legacy reports."""
+    from dlbb_tpu.models.configs import kv_cache_bytes_raw
+
+    model = report.get("model", {})
+    mesh = report.get("mesh", {})
+    serving = report.get("serving", {})
+    h = int(model.get("hidden_size", 0) or 0)
+    layers = int(model.get("num_layers", 0) or 0)
+    heads = int(model.get("num_heads", 0) or 0)
+    max_batch = int(serving.get("max_batch", 0) or 0)
+    max_seq = int(serving.get("max_seq", 0) or 0)
+    if not (h and layers and heads and max_batch and max_seq):
+        return {}
+    kvh = int(model.get("kv_heads", heads) or heads)
+    tp = max(1, int(mesh.get("tp", 1) or 1))
+    dp = max(1, int(mesh.get("dp", 1) or 1))
+    dtype = str(model.get("dtype", "bfloat16"))
+    dtype_bytes = 4 if "32" in dtype else 2
+    params_bytes = 12 * layers * h * h * dtype_bytes
+    cache_dev = kv_cache_bytes_raw(
+        layers, max_batch, max_seq, kvh, h // heads, dtype) // (dp * tp)
+    resident = params_bytes // tp + cache_dev
+    buckets = serving.get("prefill_buckets") or [max_seq]
+    mean_bucket = int(sum(buckets) / max(len(buckets), 1))
+    return {
+        "decode": resident + 8 * max_batch * 3 * h * dtype_bytes,
+        "prefill": resident + 8 * mean_bucket * 3 * h * dtype_bytes,
+    }
+
+
 # ---------------------------------------------------------------------------
 # the attribute run
 # ---------------------------------------------------------------------------
@@ -391,9 +439,12 @@ def run_attribution(
 
     serving = any(str(r.get("event", "")).startswith("request-")
                   for r in session)
+    peak_bytes: dict[str, int] = {}
     if serving:
+        report = _serving_report(input_dir) or {}
         entities, predicted, device_us = _serving_entities(
-            input_dir, session, cost_tier)
+            input_dir, session, cost_tier, report)
+        peak_bytes = _serving_peak_bytes(report)
     else:
         entities, predicted, device_us = _sweep_entities(
             input_dir, session, cost_tier)
@@ -415,6 +466,10 @@ def run_attribution(
         # (one captured execution x the recorded execution count);
         # empty when the run was uncaptured
         "device_us": device_us,
+        # static per-phase peak-memory prediction (what was RESIDENT
+        # while the time went) — serving phases only; phases without a
+        # memory model stay honest-blank (docs/memory_audit.md)
+        "peak_bytes": peak_bytes,
         "entities": entities,
         "torn_journal_lines": torn,
     }
@@ -514,16 +569,10 @@ def _sweep_entities(input_dir: Path, session: list[dict],
     return entities, predicted, device_us
 
 
-def _serving_entities(input_dir: Path, session: list[dict],
-                      tier: CostTier
-                      ) -> tuple[list[dict], dict, dict]:
-    """Per-request measured rows (queue-wait / prefill / decode from the
-    journal lifecycle) + phase-level predictions from the run report's
-    exact dispatch counts + device-measured phase totals from the run's
-    capture metas (one captured dispatch per phase x the dispatch
-    count)."""
-    report: dict[str, Any] = {}
-    for path in sorted(input_dir.glob("serving_*.json")):
+def _serving_report(input_dir: Path) -> Optional[dict[str, Any]]:
+    """The run's serving report JSON, or None when the directory holds
+    only a journal (the crashed-run case)."""
+    for path in sorted(Path(input_dir).glob("serving_*.json")):
         if path.name in ("serving_manifest.json", "serving_resume.json"):
             continue
         try:
@@ -532,8 +581,21 @@ def _serving_entities(input_dir: Path, session: list[dict],
             continue
         if isinstance(data, dict) and data.get("schema", "").startswith(
                 "dlbb_serving_report"):
-            report = data
-            break
+            return data
+    return None
+
+
+def _serving_entities(input_dir: Path, session: list[dict],
+                      tier: CostTier,
+                      report: Optional[dict[str, Any]] = None
+                      ) -> tuple[list[dict], dict, dict]:
+    """Per-request measured rows (queue-wait / prefill / decode from the
+    journal lifecycle) + phase-level predictions from the run report's
+    exact dispatch counts + device-measured phase totals from the run's
+    capture metas (one captured dispatch per phase x the dispatch
+    count)."""
+    if report is None:
+        report = _serving_report(input_dir) or {}
 
     marks: dict[str, dict[str, float]] = {}
     for rec in session:
@@ -660,12 +722,19 @@ def write_attribution(record: dict[str, Any],
         + ("  The device column is measured from the run's gated "
            "captures: one captured execution's device-op busy time x "
            "the recorded execution count (obs devtrace parses the "
-           "same captures per op)." if record.get("device_us") else ""),
+           "same captures per op)." if record.get("device_us") else "")
+        + ("  The peak column is the STATIC per-device memory "
+           "prediction for the phase's resident set (sharded weights + "
+           "KV cache + activations — docs/memory_audit.md); phases "
+           "without a memory model stay blank."
+           if record.get("peak_bytes") else ""),
         "",
-        "| phase | measured | share | device (captured) | predicted |",
-        "|---|---:|---:|---:|---:|",
+        "| phase | measured | share | device (captured) | predicted "
+        "| peak (static) |",
+        "|---|---:|---:|---:|---:|---:|",
     ]
     device_us = record.get("device_us") or {}
+    peak_bytes = record.get("peak_bytes") or {}
     for phase in PHASES:
         us = phases.get(phase)
         if not us:
@@ -673,12 +742,14 @@ def write_attribution(record: dict[str, Any],
         share = us / wall * 100 if wall else 0.0
         pred = predicted.get(phase)
         dev = device_us.get(phase)
+        peak = peak_bytes.get(phase)
         lines.append(f"| {phase} | {_fmt_us(us)} | {share:.1f}% | "
                      f"{_fmt_us(dev) if dev else '-'} | "
-                     f"{_fmt_us(pred) if pred else '-'} |")
+                     f"{_fmt_us(pred) if pred else '-'} | "
+                     f"{_fmt_bytes(peak) if peak else '-'} |")
     covered = sum(phases.values())
     lines.append(f"| **total** | {_fmt_us(covered)} | "
-                 f"{covered / wall * 100 if wall else 0:.1f}% | | |")
+                 f"{covered / wall * 100 if wall else 0:.1f}% | | | |")
     lines += [
         "",
         "## Predicted device-work decomposition",

@@ -54,6 +54,29 @@ DEFAULT_SERVE_MODEL = dict(
 )
 
 
+def _hbm_record(model_cfg: ModelConfig, serving_cfg: ServingConfig,
+                plan) -> dict:
+    """The HBM envelope a run was admitted under: the analytic
+    per-device cache footprint ``validate_serving`` priced (the number
+    the static memory audit pins against the compiled decode carry —
+    docs/memory_audit.md) next to the configured budget, recorded in
+    both the result report and the serving manifest (fresh runs and
+    resumed merges alike)."""
+    from dlbb_tpu.models.configs import kv_cache_bytes_per_device
+
+    cache_dev = kv_cache_bytes_per_device(
+        model_cfg, serving_cfg.max_batch, serving_cfg.max_seq,
+        dp=plan.dp, tp=plan.tp)
+    budget = (None if serving_cfg.hbm_budget_gb is None
+              else int(serving_cfg.hbm_budget_gb * 2**30))
+    return {
+        "kv_cache_bytes_per_device": cache_dev,
+        "budget_bytes": budget,
+        "headroom_bytes": (None if budget is None
+                           else budget - cache_dev),
+    }
+
+
 def default_parallelism(n_devices: int, kv_heads: int,
                         max_batch: int) -> tuple[int, int]:
     """Auto (dp, tp) for ``n_devices``: the largest tp in {4, 2, 1} that
@@ -217,6 +240,7 @@ def run_serving(
     report["mesh"] = plan.mesh_dict()
     report["system_info"] = collect_system_info()
     report["timestamp"] = time.time()
+    report["hbm"] = _hbm_record(model_cfg, serving_cfg, plan)
 
     # serving capture parity (docs/observability.md): the gated device
     # capture runs AFTER the trace has been served — never inside a
@@ -280,6 +304,7 @@ def run_serving(
             "compile_time_s": report["compile_time_s"],
             "decode_steps": report["decode_steps"],
             "mesh": report["mesh"],
+            "hbm": report["hbm"],
             "topology": topology,
             "journal": (None if jrn is None else jrn.path.name),
         }
@@ -488,6 +513,7 @@ def resume_serving(
     resumed["mesh"] = plan.mesh_dict()
     resumed["system_info"] = collect_system_info()
     resumed["timestamp"] = time.time()
+    resumed["hbm"] = _hbm_record(model_cfg, serving_cfg, plan)
 
     merged = merge_reports(ckpt["partial"], resumed)
     if merged.get("preempted"):
@@ -520,6 +546,7 @@ def resume_serving(
         "compile_time_s": merged["compile_time_s"],
         "decode_steps": merged["decode_steps"],
         "mesh": merged["mesh"],
+        "hbm": merged.get("hbm"),
         "topology": topology_record(),
         "journal": jrn.path.name,
     }

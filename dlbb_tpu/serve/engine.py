@@ -1965,9 +1965,11 @@ class ServingEngine:
                         stats.generated_tokens += 1
                         scheduled = True
                         if self.capture_tokens:
+                            # device-side argmax: a 4-byte scalar comes
+                            # to host per admission, never the whole
+                            # hidden state (host-transfer-in-loop)
                             tokens_by_rid.setdefault(req.rid, []).append(
-                                int(np.argmax(
-                                    np.asarray(y_last, np.float32))))
+                                int(jnp.argmax(y_last)))
                         self._event("request-prefill", req.rid, slot=slot,
                                     bucket=bucket,
                                     ttft_s=round(t_first - req.arrival_s, 6))

@@ -32,6 +32,29 @@ JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze diff --simulate 8
 JAX_PLATFORMS=cpu python -m pytest tests/test_schedule_audit.py -q \
     -m schedule_smoke -p no:cacheprovider
 
+# memory_smoke (docs/memory_audit.md): the buffer-liveness memory audit
+# runs INSIDE `analyze all` above (per-target peak_live_bytes against
+# the analytic ceilings, donation aliasing, the transient-replicated
+# gate and the serving-cache cross-check), and `analyze diff` above
+# regression-gates the committed peak/transient snapshots (>10% growth
+# on the memory axis alone fails).  The pytest marker pins the donation
+# proof on real serving/train targets AND the seeded violations
+# (dropped donation, fat replicated intermediate) exiting 1; the CLI
+# run below exercises the observability surface — memory_audit.json +
+# sweep_manifest merge + analysis_peak_live_bytes gauges — over the
+# default registry, clean with zero suppressions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_memory_audit.py -q \
+    -m memory_smoke -p no:cacheprovider
+MEM_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze memory --simulate 8 \
+    --strict-warnings --output "$MEM_TMP"
+grep -q 'dlbb_analysis_peak_live_bytes' "$MEM_TMP/metrics.prom" \
+    || { echo "memory_smoke: metrics.prom lost the peak gauges"; exit 1; }
+grep -q '"memory_audit"' "$MEM_TMP/sweep_manifest.json" \
+    || { echo "memory_smoke: manifest lost the memory-audit record"; \
+         exit 1; }
+rm -rf "$MEM_TMP"
+
 # obs_smoke (docs/observability.md): a span-traced + device-captured
 # mini-sweep must publish stats equivalent to an untraced serial run
 # (dedicated profile reps never enter the stats series; the span trace

@@ -103,6 +103,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "memory_smoke: static memory-audit smoke — real serving/train "
+        "targets prove donated buffers aliased and the analytic cache "
+        "bytes pinned to the compiled carry; seeded violations "
+        "(dropped donation, replicated spike) must exit 1 (tier-1; "
+        "also invoked standalone by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "devtrace_smoke: device-trace analysis smoke — captured "
         "overlap-variant mini-sweep stays stats-equivalent to an "
         "uncaptured run and `obs devtrace` reports measured overlap "

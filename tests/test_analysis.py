@@ -340,6 +340,97 @@ def test_lint_jit_in_loop_def():
     assert lint_source(hoisted, "fixture.py")[0] == []
 
 
+HOST_TRANSFER_LOOP_FIXTURE = textwrap.dedent("""
+    import jax
+    import numpy as np
+
+    def collect(model, xs):
+        outs = []
+        for x in xs:
+            y = model(x)
+            y.block_until_ready()
+            outs.append(np.asarray(jax.device_get(y)))
+        return outs
+""")
+
+
+def test_lint_host_transfer_in_loop():
+    """The host-side twin of jit-in-loop: a per-iteration device->host
+    transfer/sync serialises dispatch into every trip."""
+    findings, _ = lint_source(HOST_TRANSFER_LOOP_FIXTURE, "fixture.py")
+    assert [f.rule for f in findings] == ["host-transfer-in-loop"] * 3
+    assert all(f.severity == "warning" for f in findings)
+    batched = textwrap.dedent("""
+        import numpy as np
+
+        def collect(model, xs):
+            outs = [model(x) for x in xs]
+            return np.asarray(outs)
+    """)
+    assert lint_source(batched, "fixture.py")[0] == []
+
+
+def test_lint_host_transfer_in_loop_exemptions():
+    """Timed regions (the timed-region rules own them), constant-literal
+    probe ladders, loop-exit paths, jnp.asarray (device-side), and the
+    measurement API homes are all exempt."""
+    src = textwrap.dedent("""
+        import time
+        import jax
+        import jax.numpy as jnp
+
+        def measure(jitted, xs, guard):
+            for mode in ("head", "whole"):
+                jax.device_get(mode)
+            for x in xs:
+                if guard.requested:
+                    state = jax.device_get(x)
+                    break
+                t0 = time.perf_counter()
+                out = jitted(x)
+                jax.block_until_ready(out)
+                dt = time.perf_counter() - t0
+                dev = jnp.asarray(x)
+            return dt
+    """)
+    assert lint_source(src, "fixture.py")[0] == []
+    # Timer-block bodies defer to host-sync-in-timed-region's bracketing
+    # convention (the final statement is the sanctioned closing sync)
+    timer = textwrap.dedent("""
+        from dlbb_tpu.utils.metrics import Timer
+
+        def measure(jitted, xs):
+            times = []
+            for x in xs:
+                with Timer() as t:
+                    out = jitted(x)
+                    jax.block_until_ready(out)
+                times.append(t.elapsed)
+            return times
+    """)
+    assert lint_source(timer, "fixture.py")[0] == []
+    # the measurement/capture API homes drive the device in loops by
+    # design — exempt exactly like the profiler rule's API homes
+    assert lint_source(HOST_TRANSFER_LOOP_FIXTURE,
+                       "dlbb_tpu/utils/timing.py")[0] == []
+    assert lint_source(HOST_TRANSFER_LOOP_FIXTURE,
+                       "dlbb_tpu/obs/capture.py")[0] == []
+
+
+def test_lint_host_transfer_in_loop_suppression():
+    sup = HOST_TRANSFER_LOOP_FIXTURE.replace(
+        "y.block_until_ready()",
+        "y.block_until_ready()"
+        "  # comm-lint: disable=host-transfer-in-loop",
+    ).replace(
+        "outs.append(np.asarray(jax.device_get(y)))",
+        "# comm-lint: disable=host-transfer-in-loop\n"
+        "        outs.append(np.asarray(jax.device_get(y)))",
+    )
+    findings, hits = lint_source(sup, "fixture.py")
+    assert findings == [] and hits >= 3
+
+
 def test_lint_host_sync_in_finally_block():
     """perf_counter regions inside a ``finally:`` block are linted too."""
     src = textwrap.dedent("""
