@@ -440,6 +440,37 @@ def decode_scan_expectation(dp: int, tp: int, k: int,
     )
 
 
+def verify_step_expectation(dp: int, tp: int, gamma: int,
+                            act_bytes: int,
+                            slack: float = 1.25) -> TargetExpectation:
+    """Expectation for the speculative-decoding verify step
+    (``serve/engine.py::build_verify_step``): the γ drafted tokens plus
+    the carry token run through ONE batched ``[max_batch, γ+1, H]``
+    target forward — so the lowered program is shaped exactly like a
+    decode step whose activations are (γ+1) wide, NOT like γ+1
+    sequential decode steps.
+
+    Concretely: the kind set stays the per-token decode set (tp psums +
+    QKV realign permutes; the same single boundary all-gather artifact
+    the fused scan carries), ``min_required = 1`` — the row-parallel
+    psum fires once per scanned layer, with NO per-draft-token trip
+    weighting (a per-token re-verify loop would show up as a γ+1-trip
+    while body, and its trip-weighted wire lands past the committed
+    baseline's ``analyze diff`` gate) — and every instruction is capped
+    at (γ+1) x one step's activation bytes.  The γ+1 one-hot cache
+    appends must lower to collective-free elementwise selects, exactly
+    like the decode step's single append: ``act_bytes`` is the ONE-step
+    ceiling, so a cache regather trips the byte axis identically."""
+    return TargetExpectation(
+        allowed=plan_expected_kinds(dp=dp, tp=tp, decode=True)
+        | {"all-gather"},
+        required_any={"all-reduce"},
+        min_required=1,
+        max_bytes_per_instr=int(act_bytes * (gamma + 1) * slack),
+        expect_donation=True,
+    )
+
+
 def compact_expectation() -> TargetExpectation:
     """Expectation for the slot-compaction gather/scatter jits
     (``serve/engine.py``): pure LOCAL data movement — the slot dim is

@@ -698,20 +698,26 @@ def _compressed_train_target(compression: str = "int8",
 _SERVE_SHAPE = dict(max_batch=4, num_blocks=4, block_size=8, bucket=16)
 
 
-def _serve_cache_bytes_per_device(dp: int, tp: int) -> int:
+def _serve_cache_bytes_per_device(dp: int, tp: int,
+                                  num_layers: Optional[int] = None) -> int:
     """Analytic per-device KV-cache footprint of the serving audit
     geometry — the SAME ``models.configs.kv_cache_bytes_per_device``
     the build-time HBM budget gate prices, wired into the decode/prefill
     expectations as ``donated_bytes_expected`` so the memory audit's
     ``serving-cache-drift`` rule pins formula and compiled program to
-    each other."""
+    each other.  ``num_layers`` overrides the tiny model's depth — the
+    speculative draft plane (1 layer) prices through the same
+    formula."""
     from dlbb_tpu.models.configs import (
         ModelConfig,
         kv_cache_bytes_per_device,
     )
 
+    model = dict(_TINY_MODEL)
+    if num_layers is not None:
+        model["num_layers"] = num_layers
     return kv_cache_bytes_per_device(
-        ModelConfig(**_TINY_MODEL),
+        ModelConfig(**model),
         _SERVE_SHAPE["max_batch"],
         _SERVE_SHAPE["num_blocks"] * _SERVE_SHAPE["block_size"],
         dp=dp, tp=tp,
@@ -723,23 +729,29 @@ def _serve_build(dp: int, tp: int, what: str, k: int = 4):
     args on a (dp, tp) mesh — the exact programs ``serve/engine.py``
     runs, so the audit gates the real decode/prefill/fast-path
     lowerings.  ``what`` selects decode / decode_fused / prefill /
-    prefill_chunk / compact_gather / compact_scatter; ``k`` is the
-    fused-scan trip count."""
+    prefill_chunk / compact_gather / compact_scatter — plus the
+    speculative-decoding programs decode_fused_token / verify /
+    draft_scan; ``k`` is the fused-scan trip count (and doubles as γ
+    for the speculative targets)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from dlbb_tpu.comm.mesh import build_parallelism_mesh
+    from dlbb_tpu.data.synthetic import token_embedding_table
     from dlbb_tpu.models.configs import ModelConfig
     from dlbb_tpu.models.transformer import init_params_sharded
     from dlbb_tpu.serve.engine import (
         build_compact_gather,
         build_compact_scatter,
         build_decode_fused,
+        build_decode_fused_token,
         build_decode_step,
+        build_draft_scan,
         build_prefill,
         build_prefill_chunk,
+        build_verify_step,
         decode_batch_spec,
     )
     from dlbb_tpu.serve.kvcache import create_kv_cache
@@ -770,6 +782,39 @@ def _serve_build(dp: int, tp: int, what: str, k: int = 4):
             NamedSharding(mesh, P()),
         )
         return fn, ((cache, x), params, active, remaining)
+    if what in ("decode_fused_token", "verify", "draft_scan"):
+        table = token_embedding_table(cfg.hidden_size, dtype=jnp.float32)
+        remaining = jax.device_put(
+            jnp.full((_SERVE_SHAPE["max_batch"],), k, jnp.int32),
+            NamedSharding(mesh, P()),
+        )
+        if what == "decode_fused_token":
+            fn = build_decode_fused_token(cfg, mesh, k)
+            return fn, ((cache, x), params, table, active, remaining)
+        if what == "verify":
+            fn = build_verify_step(cfg, mesh, k)
+            ids = jax.device_put(
+                jnp.zeros((_SERVE_SHAPE["max_batch"], k), jnp.int32),
+                NamedSharding(mesh, P(decode_batch_spec(mesh)[0], None)),
+            )
+            return fn, ((cache, x), params, table, ids, active, remaining)
+        # draft_scan: the SHALLOW draft model (1 layer, everything else
+        # identical) over its OWN cache plane, host-committed lengths
+        # passed explicitly — the exact program the draft-model drafter
+        # dispatches
+        draft_cfg = ModelConfig(**{**_TINY_MODEL, "num_layers": 1})
+        draft_params = init_params_sharded(draft_cfg, jax.random.key(1),
+                                           mesh)
+        draft_cache = create_kv_cache(
+            draft_cfg, _SERVE_SHAPE["max_batch"], _SERVE_SHAPE["num_blocks"],
+            _SERVE_SHAPE["block_size"], mesh=mesh,
+        )
+        lengths = jax.device_put(
+            jnp.zeros((_SERVE_SHAPE["max_batch"],), jnp.int32),
+            NamedSharding(mesh, P()),
+        )
+        fn = build_draft_scan(draft_cfg, mesh, k)
+        return fn, (draft_cache, draft_params, table, x, lengths, active)
     if what == "prefill_chunk":
         # second chunk (nonzero static offset): nonempty prefix carry +
         # offset block write — the interesting lowering
@@ -916,6 +961,106 @@ def _decode_fused_target(dp: int = 2, tp: int = 4,
     )
 
 
+def _decode_fused_token_target(dp: int = 2, tp: int = 4,
+                               k: int = 4) -> AuditTarget:
+    """The token-feedback fused scan (``serve/engine.py::
+    build_decode_fused_token``) — the n-gram-drafted engine's
+    between-verify workhorse and the speculative modes' plain-decode
+    fallback.  Identical contract to the float fused scan: the greedy
+    quantisation (argmax + a replicated [H, H] table take) adds ZERO
+    collectives, so the same ``decode_scan_expectation`` applies
+    unchanged — any new wire from the token feedback is a regression."""
+    from dlbb_tpu.analysis.expectations import decode_scan_expectation
+
+    def build():
+        return _serve_build(dp, tp, "decode_fused_token", k=k)
+
+    qkv_width = 3 * _TINY_MODEL["hidden_size"]
+    act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    cache_dev = _serve_cache_bytes_per_device(dp, tp)
+    exp = decode_scan_expectation(dp, tp, k, act_bytes)
+    exp.max_peak_bytes = int(
+        1.3 * (_tiny_params_bytes() // tp + cache_dev)) + 16 * act_bytes
+    exp.donated_bytes_expected = cache_dev
+    return AuditTarget(
+        name=f"serve/engine.py::decode_fused_token[k{k},dp,tp]",
+        build=build,
+        expectation=exp,
+        min_devices=dp * tp,
+    )
+
+
+def _verify_step_target(dp: int = 2, tp: int = 4,
+                        gamma: int = 4) -> AuditTarget:
+    """The speculative verify step (``serve/engine.py::
+    build_verify_step``): γ drafted tokens + the carry token through ONE
+    batched [max_batch, γ+1, H] target forward.  The expectation
+    (``verify_step_expectation``) pins the "one fused forward, zero
+    per-draft-token collectives" contract: per-token decode kinds only,
+    one psum per scanned layer, every instruction within (γ+1) x one
+    step's activation bytes — the γ+1 one-hot cache appends must lower
+    to collective-free selects exactly like the decode step's single
+    append, and the acceptance math (argmax + cumprod + gather) is
+    elementwise/local."""
+    from dlbb_tpu.analysis.expectations import verify_step_expectation
+
+    def build():
+        return _serve_build(dp, tp, "verify", k=gamma)
+
+    qkv_width = 3 * _TINY_MODEL["hidden_size"]
+    act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    cache_dev = _serve_cache_bytes_per_device(dp, tp)
+    exp = verify_step_expectation(dp, tp, gamma, act_bytes)
+    # weights + donated cache + (γ+1)-wide activations/scores (the
+    # verify's [B, γ+1, S] mask and [B, n, γ+1, S] score planes are a
+    # few KB at the audit geometry)
+    exp.max_peak_bytes = int(
+        1.3 * (_tiny_params_bytes() // tp + cache_dev)
+    ) + 16 * (gamma + 1) * act_bytes
+    exp.donated_bytes_expected = cache_dev
+    return AuditTarget(
+        name=f"serve/engine.py::verify_step[gamma{gamma},dp,tp]",
+        build=build,
+        expectation=exp,
+        min_devices=dp * tp,
+    )
+
+
+def _draft_scan_target(dp: int = 2, tp: int = 4,
+                       gamma: int = 4) -> AuditTarget:
+    """The draft-model proposal scan (``serve/engine.py::
+    build_draft_scan``): γ greedy steps of the 1-layer draft transformer
+    over its OWN donated cache plane, sharded by the SAME plan as the
+    target (``draft_model_config``).  The fused-scan expectation applies
+    at trip count γ; the donated-bytes cross-check prices the SECOND
+    cache plane — the same ``kv_cache_bytes_per_device`` formula
+    ``validate_serving``'s draft-aware HBM gate prices at admission, so
+    the build-time rejection can never drift from the draft plane XLA
+    actually allocates."""
+    from dlbb_tpu.analysis.expectations import decode_scan_expectation
+
+    def build():
+        return _serve_build(dp, tp, "draft_scan", k=gamma)
+
+    qkv_width = 3 * _TINY_MODEL["hidden_size"]
+    act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    draft_cache_dev = _serve_cache_bytes_per_device(dp, tp, num_layers=1)
+    exp = decode_scan_expectation(dp, tp, gamma, act_bytes)
+    # 1-layer draft weights are a fraction of the target's; pricing the
+    # full tiny-model params keeps comfortable headroom while the
+    # donated check stays exact on the draft plane
+    exp.max_peak_bytes = int(
+        1.3 * (_tiny_params_bytes() // tp + draft_cache_dev)
+    ) + 16 * act_bytes
+    exp.donated_bytes_expected = draft_cache_dev
+    return AuditTarget(
+        name=f"serve/engine.py::draft_scan[gamma{gamma},dp,tp]",
+        build=build,
+        expectation=exp,
+        min_devices=dp * tp,
+    )
+
+
 def _prefill_chunk_target(dp: int = 2, tp: int = 4) -> AuditTarget:
     """One chunk of a chunked prefill at a nonzero static offset: the
     prefix K/V rides an explicit (slot-dim-free) carry, so the lowered
@@ -1055,8 +1200,10 @@ def default_targets() -> list[AuditTarget]:
     and without the overlapped collective-matmul schedule, the
     DDP + ZeRO-1 + overlapped-TP train steps, and the serving programs
     — per-step decode + monolithic prefill plus the decode fast path
-    (fused K-step scan, chunked prefill, compaction gather/scatter) —
-    all tiny-collectives-only with the cache-regather byte gate."""
+    (fused K-step scan, chunked prefill, compaction gather/scatter) and
+    the speculative-decoding programs (token-feedback fused scan,
+    γ-token verify step, draft-model proposal scan) — all
+    tiny-collectives-only with the cache-regather byte gate."""
     targets = registry_op_targets()
     targets.append(_barrier_target())
     targets.append(_tp_forward_target())
@@ -1071,6 +1218,9 @@ def default_targets() -> list[AuditTarget]:
     targets.append(_decode_step_target())
     targets.append(_prefill_target())
     targets.append(_decode_fused_target())
+    targets.append(_decode_fused_token_target())
+    targets.append(_verify_step_target())
+    targets.append(_draft_scan_target())
     targets.append(_prefill_chunk_target())
     targets.append(_compact_target("compact_gather"))
     targets.append(_compact_target("compact_scatter"))

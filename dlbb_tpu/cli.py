@@ -346,6 +346,23 @@ def build_parser() -> argparse.ArgumentParser:
                     help="occupancy fraction (0, 0.5] at or below which "
                          "fused scans run on a gather-compacted half "
                          "batch (dp=1 meshes only; default: off)")
+    sv.add_argument("--speculation", default=None,
+                    choices=["off", "greedy", "ngram", "draft-model"],
+                    help="decode feedback / drafting mode: off = legacy "
+                         "continuous feedback, greedy = token feedback "
+                         "without drafting, ngram = prompt-lookup "
+                         "self-speculation, draft-model = shallow draft "
+                         "transformer on the same mesh "
+                         "(docs/serving.md, 'Speculative decoding')")
+    sv.add_argument("--spec-gamma", type=int, default=None,
+                    dest="spec_gamma",
+                    help="draft tokens proposed per verify step (the γ "
+                         "of draft-and-verify; required by ngram / "
+                         "draft-model)")
+    sv.add_argument("--spec-adaptive", action="store_true", default=None,
+                    dest="spec_adaptive",
+                    help="per-request adaptive γ: back off to a smaller "
+                         "verify width on low acceptance EMA")
     sv.add_argument("--slo", type=float, default=None, metavar="SEC",
                     help="per-request deadline (SLO) stamped on every "
                          "generated request: queued requests whose wait "
@@ -767,6 +784,9 @@ def _dispatch(args) -> int:
                 "inflight_window": args.inflight_window,
                 "prefill_chunk": args.prefill_chunk,
                 "compact_threshold": args.compact_threshold,
+                "speculation": args.speculation,
+                "spec_gamma": args.spec_gamma,
+                "spec_adaptive": args.spec_adaptive,
                 "max_dispatch_retries": args.max_dispatch_retries,
                 "dispatch_deadline_factor":
                     args.dispatch_deadline_factor,

@@ -49,12 +49,34 @@ class SyntheticEmbeddingDataset:
         return self._batch
 
 
+def _request_host_embeddings(seed: int, prompt_len: int,
+                             hidden_size: int,
+                             period: Optional[int] = None) -> np.ndarray:
+    """The host-side float32 prompt array both :func:`request_embeddings`
+    and :func:`prompt_token_ids` derive from — ONE rng consumption
+    pattern, so the device prompt and its host-side token-id view can
+    never drift.  ``period`` tiles a seeded motif of that many positions
+    (the repeating-structure traffic variant, ``serve/traffic.py``);
+    None keeps the original draw byte-identical."""
+    rng = np.random.default_rng(seed)
+    if period is not None:
+        if period < 1:
+            raise ValueError(f"period must be >= 1, got {period}")
+        motif = rng.standard_normal((1, period, hidden_size),
+                                    dtype=np.float32)
+        reps = -(-prompt_len // period)
+        return np.tile(motif, (1, reps, 1))[:, :prompt_len]
+    return rng.standard_normal((1, prompt_len, hidden_size),
+                               dtype=np.float32)
+
+
 def request_embeddings(
     seed: int,
     prompt_len: int,
     hidden_size: int,
     dtype=jnp.bfloat16,
     pad_to: Optional[int] = None,
+    period: Optional[int] = None,
 ) -> jax.Array:
     """Seeded synthetic prompt embeddings for ONE serving request:
     ``[1, prompt_len, hidden]`` (``[1, pad_to, hidden]`` when padded for a
@@ -65,19 +87,60 @@ def request_embeddings(
     benchmark measures scheduling and communication, not input variety,
     but each request still gets its own deterministic inputs (seed from
     the trace, ``serve/traffic.py``) so a replayed trace replays the
-    exact computation."""
+    exact computation.  ``period`` tiles a seeded motif instead of a
+    fully random draw (the repeating-structure trace variant the
+    speculative-decoding bench uses, so n-gram drafting has structure
+    to look up); None is byte-identical to the original draw."""
     if pad_to is not None and pad_to < prompt_len:
         raise ValueError(
             f"pad_to={pad_to} is shorter than prompt_len={prompt_len}"
         )
-    rng = np.random.default_rng(seed)
-    host = rng.standard_normal((1, prompt_len, hidden_size),
-                               dtype=np.float32)
+    host = _request_host_embeddings(seed, prompt_len, hidden_size,
+                                    period=period)
     if pad_to is not None and pad_to > prompt_len:
         host = np.concatenate(
             [host, np.zeros((1, pad_to - prompt_len, hidden_size),
                             dtype=np.float32)], axis=1,
         )
+    return jnp.asarray(host, dtype=dtype)
+
+
+def prompt_token_ids(seed: int, prompt_len: int, hidden_size: int,
+                     period: Optional[int] = None) -> list[int]:
+    """The prompt's greedy token-id view: per-position argmax of the SAME
+    host array :func:`request_embeddings` uploads — the n-gram drafter's
+    prompt-lookup context (``serve/engine.py``).  Pure numpy, computed at
+    admission: drafting hints never need device transfers, and a wrong
+    hint costs only acceptance (the target verify gates every commit)."""
+    host = _request_host_embeddings(seed, prompt_len, hidden_size,
+                                    period=period)
+    return [int(t) for t in np.argmax(host[0], axis=-1)]
+
+
+# Fixed seed for the greedy token-embedding table: one global vocabulary
+# per hidden size, shared by every engine so token-identity comparisons
+# across engines/meshes are meaningful.
+_TOKEN_TABLE_SEED = 0xD1BB
+
+
+def token_embedding_table(hidden_size: int, dtype=jnp.bfloat16) -> jax.Array:
+    """The greedy-decode token embedding table ``[H, H]``.
+
+    The serving engine's legacy decode feeds each output hidden state
+    straight back as the next input (the model is its own next-token
+    function) — a CONTINUOUS feedback with no discrete token alphabet,
+    which speculative decoding cannot draft against.  Greedy token
+    feedback (``serving.speculation != "off"``) quantises the loop
+    through this table: the committed token is ``argmax`` over the
+    output hidden state (vocab = hidden_size, the argmax alphabet the
+    equivalence gate already records), and the next input is that
+    token's row here.  ``emb(token)`` being a deterministic function of
+    the token id is exactly what makes a verified draft bit-identical
+    to the sequential step — the foundation of the token-identity
+    contract (docs/serving.md, "Speculative decoding")."""
+    rng = np.random.default_rng(_TOKEN_TABLE_SEED)
+    host = rng.standard_normal((hidden_size, hidden_size),
+                               dtype=np.float32)
     return jnp.asarray(host, dtype=dtype)
 
 

@@ -305,7 +305,8 @@ def kv_cache_bytes_per_device(config: ModelConfig, max_batch: int,
 
 def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
                      block_size: int, dp: int = 1, tp: int = 1,
-                     hbm_budget_bytes: Optional[int] = None) -> None:
+                     hbm_budget_bytes: Optional[int] = None,
+                     draft_config: Optional[ModelConfig] = None) -> None:
     """Reject serving configurations the engine cannot run — at build
     time, with a clear error, never as an OOM (or a wrong answer) in the
     middle of a trace.
@@ -315,7 +316,16 @@ def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
     dp tiles the slot dim; tp tiles kv_heads), and — when
     ``hbm_budget_bytes`` is set — the per-device KV-cache HBM footprint:
     ``max_batch x max_seq`` K/V at kv_heads width, divided by the dp x tp
-    shards that actually partition it."""
+    shards that actually partition it.
+
+    ``draft_config`` is the speculative-decoding draft model
+    (``serving.speculation="draft-model"``): it is validated against the
+    SAME mesh and cache geometry (the draft plane is sharded by the same
+    ``ParallelismPlan``, so e.g. its ``kv_heads % tp`` contract is
+    identical), and its resident weights + second KV-cache plane are
+    priced INTO the HBM budget alongside the target cache — an
+    infeasible ``(spec, max_batch, gamma)`` combination fails here at
+    build time, not as an OOM mid-trace."""
     if config.attention not in SERVABLE_ATTENTION:
         raise ValueError(
             f"serving requires attention in {SERVABLE_ATTENTION} "
@@ -353,17 +363,44 @@ def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
             "KV-cache shards its head dim over tp, so GQA configs need "
             "kv_heads % tp == 0 (pick a smaller tp or more kv heads)"
         )
+    if draft_config is not None:
+        try:
+            validate_serving(draft_config, max_batch, max_seq, block_size,
+                             dp=dp, tp=tp)
+        except ValueError as e:
+            raise ValueError(
+                f"speculative draft model is not servable on the same "
+                f"ParallelismPlan (dp={dp}, tp={tp}): {e}"
+            ) from e
     if hbm_budget_bytes is not None:
         per_device = kv_cache_bytes_per_device(
             config, max_batch, max_seq, dp=dp, tp=tp)
-        if per_device > hbm_budget_bytes:
+        draft_bytes = 0
+        if draft_config is not None:
+            # the draft plane is resident for the whole trace: weights
+            # (sharded over tp like the target's) + its own paged
+            # KV-cache plane, priced against the SAME budget
+            from dlbb_tpu.models.transformer import num_parameters
+
+            draft_bytes = (
+                num_parameters(draft_config)
+                * _DTYPE_BYTES.get(draft_config.dtype, 2)
+                // (tp if tp > 1 else 1)
+                + kv_cache_bytes_per_device(
+                    draft_config, max_batch, max_seq, dp=dp, tp=tp)
+            )
+        if per_device + draft_bytes > hbm_budget_bytes:
+            draft_note = (
+                f" + speculative draft plane {draft_bytes / 2**30:.2f} "
+                "GiB (weights + second KV-cache)" if draft_bytes else "")
             raise ValueError(
                 f"serving KV-cache footprint {per_device / 2**30:.2f} GiB "
                 f"per device (max_batch={max_batch} x max_seq={max_seq} "
                 f"x {config.num_layers} layers x kv_heads="
                 f"{config.kv_heads} x head_dim={config.head_dim} x 2 "
                 f"(K+V) x {_DTYPE_BYTES[config.dtype]} B "
-                f"[{config.dtype}], sharded over dp={dp} x tp={tp}) "
+                f"[{config.dtype}], sharded over dp={dp} x tp={tp})"
+                f"{draft_note} "
                 f"exceeds the HBM budget of "
                 f"{hbm_budget_bytes / 2**30:.2f} GiB — shrink max_batch/"
                 "max_seq or raise serving.hbm_budget_gb if the device "

@@ -264,6 +264,32 @@ def serving_metrics(report: dict[str, Any],
                      res.get("hung_dispatches", 0),
                      help="decode units abandoned by the dispatch "
                           "watchdog")
+    # speculative decoding: the per-drafter proposed/accepted counters
+    # (serve_spec_proposed_total / serve_spec_accepted_total) and the
+    # acceptance-EMA gauge are live ENGINE metrics; when folding a bare
+    # report into a fresh registry, seed the totals from the report's
+    # speculation sub-dict so the export is self-contained either way
+    spec = report.get("speculation", {})
+    if spec and spec.get("mode") not in (None, "off"):
+        drafter = spec["mode"]
+        if registry.get("serve_spec_proposed_total", drafter=drafter) == 0:
+            registry.inc("serve_spec_proposed_total",
+                         spec.get("proposed_tokens", 0), drafter=drafter,
+                         help="draft tokens proposed to the verify step, "
+                              "by drafter")
+            registry.inc("serve_spec_accepted_total",
+                         spec.get("accepted_tokens", 0), drafter=drafter,
+                         help="draft tokens the target verify accepted, "
+                              "by drafter")
+        if spec.get("acceptance_rate") is not None:
+            registry.set_gauge("serve_spec_acceptance_ema",
+                               spec["acceptance_rate"],
+                               help="run-level draft acceptance EMA")
+        if spec.get("mean_accepted_len") is not None:
+            registry.set_gauge("serve_spec_mean_accepted_len",
+                               spec["mean_accepted_len"],
+                               help="mean tokens committed per verify "
+                                    "unit slot (accepted + bonus)")
     for metric, key in (("serve_ttft_seconds", "ttft"),
                         ("serve_per_token_seconds", "per_token_latency")):
         summary = report.get(key, {})

@@ -310,7 +310,8 @@ def _classify_stream(records: list[dict[str, Any]]) -> list[int]:
             ambient = (_SERVE_PID if rec.get("mode") == "serve"
                        else _SWEEP_PID)
             pids.append(ambient)
-        elif ev.startswith("request-") or ev.startswith("serve"):
+        elif (ev.startswith("request-") or ev.startswith("serve")
+              or ev.startswith("spec-")):
             pids.append(_SERVE_PID)
         else:
             pids.append(ambient)

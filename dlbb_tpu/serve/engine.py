@@ -95,7 +95,7 @@ import signal
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, replace as dc_replace
 from typing import Any, Optional
 
 import jax
@@ -103,7 +103,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dlbb_tpu.data.synthetic import request_embeddings
+from dlbb_tpu.data.synthetic import (
+    prompt_token_ids,
+    request_embeddings,
+    token_embedding_table,
+)
 from dlbb_tpu.models.configs import ModelConfig, validate_serving
 from dlbb_tpu.models.attention import dense_attention
 from dlbb_tpu.models.transformer import (
@@ -133,6 +137,12 @@ from dlbb_tpu.serve.traffic import Request, TrafficTrace
 from dlbb_tpu.utils.metrics import Timer, summarize
 
 SERVING_REPORT_SCHEMA = "dlbb_serving_report_v1"
+
+# decode feedback / drafting modes (ServingConfig.speculation):
+# "off" = legacy continuous hidden-state feedback; "greedy" = token
+# feedback without drafting (the speculative modes' pinned oracle);
+# "ngram" / "draft-model" = draft-and-verify speculative decoding
+SPECULATION_MODES = ("off", "greedy", "ngram", "draft-model")
 
 
 # ---------------------------------------------------------------------------
@@ -207,6 +217,27 @@ class ServingConfig:
                      disables — zero threads, zero overhead.
     dispatch_deadline_min_s: watchdog floor while the per-step EMA is
                      still cold (and for tiny EMAs).
+    speculation:     decode feedback / drafting mode ("off" = the legacy
+                     continuous hidden-state feedback, bit-for-bit
+                     preserved).  The token modes quantise decode
+                     through the deterministic greedy token table
+                     (``data.synthetic.token_embedding_table``):
+                     "greedy" is token feedback WITHOUT drafting (the
+                     pinned per-step/fused oracle the speculative modes
+                     are token-identical to); "ngram" adds host-side
+                     prompt-lookup self-speculation (zero extra model);
+                     "draft-model" adds a shallow draft transformer on
+                     the same ParallelismPlan with its own paged KV
+                     plane (docs/serving.md, "Speculative decoding").
+    spec_gamma:      draft tokens proposed per verify step (the γ of
+                     draft-and-verify); requires a drafting mode.
+    spec_adaptive:   per-request adaptive γ — back off to a smaller
+                     verify ladder bucket on low acceptance EMA, climb
+                     back on high (requires a drafting mode).
+    spec_draft_layers: draft-model depth (layers of the shallow draft
+                     transformer; every other dim matches the target).
+    spec_draft_kv_heads: draft-model GQA kv_heads override (None =
+                     the target's; must keep kv_heads % tp == 0).
     """
 
     max_batch: int = 8
@@ -225,6 +256,11 @@ class ServingConfig:
     retry_backoff_s: float = 0.05
     dispatch_deadline_factor: Optional[float] = None
     dispatch_deadline_min_s: float = 0.25
+    speculation: str = "off"
+    spec_gamma: int = 0
+    spec_adaptive: bool = False
+    spec_draft_layers: int = 1
+    spec_draft_kv_heads: Optional[int] = None
 
     def __post_init__(self) -> None:
         if not self.prefill_buckets:
@@ -254,9 +290,21 @@ class ServingConfig:
                  tp: int = 1) -> None:
         budget = (None if self.hbm_budget_gb is None
                   else int(self.hbm_budget_gb * 2**30))
+        if self.speculation not in SPECULATION_MODES:
+            raise ValueError(
+                f"serving.speculation={self.speculation!r} must be one "
+                f"of {SPECULATION_MODES}"
+            )
+        # speculation with tp_overlap != off or non-dense attention is
+        # rejected inside validate_serving (those envelopes cannot serve
+        # at all); the draft plane re-runs the same gate on its own
+        # config below, so a draft kv plane breaking kv_heads % tp
+        # fails here at build time too
+        draft = (self.draft_model_config(config)
+                 if self.speculation == "draft-model" else None)
         validate_serving(config, self.max_batch, self.max_seq,
                          self.block_size, dp=dp, tp=tp,
-                         hbm_budget_bytes=budget)
+                         hbm_budget_bytes=budget, draft_config=draft)
         for b in self.prefill_buckets:
             if b % self.block_size != 0 or not 0 < b <= self.max_seq:
                 raise ValueError(
@@ -355,6 +403,94 @@ class ServingConfig:
                 f"serving.dispatch_deadline_min_s must be > 0 seconds, "
                 f"got {self.dispatch_deadline_min_s}"
             )
+        # -- speculation ladder (same no-op-trap contract as
+        #    compact_threshold/inflight_window: a knob that would
+        #    silently do nothing is a config error) --
+        if self.spec_drafting:
+            if self.spec_gamma < 1:
+                raise ValueError(
+                    f"serving.speculation={self.speculation!r} requires "
+                    f"spec_gamma >= 1 (got {self.spec_gamma}): a drafter "
+                    "with zero proposals per verify is a silent no-op "
+                    "that still pays the verify compiles"
+                )
+            if self.spec_gamma + 1 > self.max_seq:
+                raise ValueError(
+                    f"serving.spec_gamma={self.spec_gamma} cannot exceed "
+                    f"max_seq-1={self.max_seq - 1}: a verify step "
+                    "appends gamma+1 positions to one slot"
+                )
+        else:
+            if self.spec_gamma:
+                raise ValueError(
+                    f"serving.spec_gamma={self.spec_gamma} requires a "
+                    "drafting speculation mode ('ngram' or "
+                    "'draft-model'); with speculation="
+                    f"{self.speculation!r} no verify step ever runs, so "
+                    "the knob would be a silent no-op"
+                )
+            if self.spec_adaptive:
+                raise ValueError(
+                    "serving.spec_adaptive requires a drafting "
+                    "speculation mode ('ngram' or 'draft-model'): "
+                    "there is no acceptance EMA to adapt to with "
+                    f"speculation={self.speculation!r}"
+                )
+        if self.speculation != "off" and self.compact_threshold is not None:
+            raise ValueError(
+                "serving.compact_threshold cannot combine with "
+                f"speculation={self.speculation!r}: token-feedback and "
+                "verify units run on the full decode batch (no "
+                "compacted token/verify program exists), so compaction "
+                "would be a silent no-op that still pays the gather/"
+                "scatter compiles"
+            )
+        if self.speculation == "draft-model":
+            if self.spec_draft_layers < 1:
+                raise ValueError(
+                    f"serving.spec_draft_layers must be >= 1, got "
+                    f"{self.spec_draft_layers}"
+                )
+            if self.prefill_chunk is not None:
+                raise ValueError(
+                    "serving.prefill_chunk cannot combine with "
+                    "speculation='draft-model': the draft KV plane is "
+                    "prefilled monolithically at admission, and a "
+                    "chunked target prefill would leave it silently "
+                    "unfilled"
+                )
+
+    @property
+    def spec_drafting(self) -> bool:
+        """True when a drafter runs (verify steps exist)."""
+        return self.speculation in ("ngram", "draft-model")
+
+    @property
+    def spec_gammas(self) -> tuple[int, ...]:
+        """The verify-step γ ladder: powers of two 1, 2, 4, ... below
+        ``spec_gamma``, plus ``spec_gamma`` itself (adaptive γ backs
+        off through these buckets; empty when not drafting)."""
+        if not self.spec_drafting:
+            return ()
+        gs = []
+        g = 1
+        while g < self.spec_gamma:
+            gs.append(g)
+            g *= 2
+        gs.append(self.spec_gamma)
+        return tuple(sorted(set(gs)))
+
+    def draft_model_config(self, config: ModelConfig) -> ModelConfig:
+        """The draft transformer's config: the target at
+        ``spec_draft_layers`` depth (and an optional kv_heads
+        override), everything else — hidden size, heads, dtype,
+        attention — identical, so the draft shares the target's
+        ParallelismPlan and its outputs live in the same hidden/token
+        space the verify step argmaxes over."""
+        kwargs: dict[str, Any] = {"num_layers": self.spec_draft_layers}
+        if self.spec_draft_kv_heads is not None:
+            kwargs["num_kv_heads"] = self.spec_draft_kv_heads
+        return dc_replace(config, **kwargs)
 
     def bucket_for(self, prompt_len: int) -> int:
         for b in self.prefill_buckets:
@@ -373,7 +509,9 @@ class ServingConfig:
                   "inflight_window", "prefill_chunk", "compact_threshold",
                   "reject_infeasible", "max_dispatch_retries",
                   "retry_backoff_s", "dispatch_deadline_factor",
-                  "dispatch_deadline_min_s"):
+                  "dispatch_deadline_min_s", "speculation", "spec_gamma",
+                  "spec_adaptive", "spec_draft_layers",
+                  "spec_draft_kv_heads"):
             if k in d:
                 fields[k] = d[k]
         if "prefill_buckets" in d:
@@ -399,6 +537,11 @@ class ServingConfig:
             "retry_backoff_s": self.retry_backoff_s,
             "dispatch_deadline_factor": self.dispatch_deadline_factor,
             "dispatch_deadline_min_s": self.dispatch_deadline_min_s,
+            "speculation": self.speculation,
+            "spec_gamma": self.spec_gamma,
+            "spec_adaptive": self.spec_adaptive,
+            "spec_draft_layers": self.spec_draft_layers,
+            "spec_draft_kv_heads": self.spec_draft_kv_heads,
         }
 
     @property
@@ -853,6 +996,329 @@ def _inject_token(carry, slot, vec):
     return cache, jnp.where(mask, vec[None, None, :].astype(x.dtype), x)
 
 
+# ---------------------------------------------------------------------------
+# speculative decoding (docs/serving.md, "Speculative decoding")
+# ---------------------------------------------------------------------------
+
+
+def _inject_token_greedy(carry, slot, vec, table):
+    """Token-mode admission inject: quantise the prefill's last output
+    through the greedy token table (``tok = argmax(vec)``, ``x[slot, 0]
+    = table[tok]``) and return the token id — the 4-byte scalar is the
+    only thing that ever comes to host (the n-gram drafter's history
+    seed + the equivalence gate's capture)."""
+    cache, x = carry
+    tok = jnp.argmax(vec).astype(jnp.int32)
+    emb = jnp.take(table, tok, axis=0)
+    return ((cache,
+             jnp.where((jnp.arange(x.shape[0]) == slot)[:, None, None],
+                       emb[None, None, :].astype(x.dtype), x)),
+            tok)
+
+
+def _verify_attention(q: jax.Array, k_flat: jax.Array, v_flat: jax.Array,
+                      valid: jax.Array) -> jax.Array:
+    """Offset-causal length-masked attention for one verify step.
+
+    q: ``[B, n, G, d]`` (G = gamma+1 verify positions per slot);
+    k_flat/v_flat: ``[B, S_max, kvh, d]``; valid: ``[B, G, S_max]`` bool
+    — query ``i`` of slot ``b`` reaches keys ``j <= lengths[b] + i``
+    (the per-slot offset-causal mask, ``_chunk_attention``'s static mask
+    made per-slot dynamic).  Same math as ``_cached_attention`` (fp32
+    softmax, 1/sqrt(d), grouped-query broadcasting), of which it is the
+    G>1 generalisation."""
+    b, n, g, d = q.shape
+    kvh = k_flat.shape[2]
+    q32 = q.astype(jnp.float32)
+    k32 = k_flat.transpose(0, 2, 1, 3).astype(jnp.float32)  # [B, kvh, S, d]
+    v32 = v_flat.transpose(0, 2, 1, 3).astype(jnp.float32)
+    if kvh != n:
+        q32 = q32.reshape(b, kvh, n // kvh, g, d)
+        logits = jnp.einsum("bhgqd,bhkd->bhgqk", q32, k32) / math.sqrt(d)
+        logits = jnp.where(valid[:, None, None, :, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhgqk,bhkd->bhgqd", probs, v32)
+        out = out.reshape(b, n, g, d)
+    else:
+        logits = jnp.einsum("bnqd,bnkd->bnqk", q32, k32) / math.sqrt(d)
+        logits = jnp.where(valid[:, None, :, :], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bnqk,bnkd->bnqd", probs, v32)
+    return out.astype(k_flat.dtype)
+
+
+def build_decode_token_step(config: ModelConfig, mesh: Mesh):
+    """Jitted token-feedback decode step: the per-step decode math
+    (verbatim ``_decode_step_math``) followed by the greedy token
+    quantisation — ``tok = argmax(y)``, next input ``table[tok]``.
+    Returns ``(carry, tok [B])``; the token ids are the committed
+    output (device argmax, never a host float transfer).  This is the
+    speculative modes' pinned per-step oracle."""
+
+    def decode_token_step(carry, params, table, active):
+        (cache, y), _ = _decode_step_math(carry, params, active, config)
+        tok = jnp.argmax(y[:, 0, :], axis=-1).astype(jnp.int32)
+        x2 = jnp.take(table, tok, axis=0)[:, None, :].astype(y.dtype)
+        return (cache, x2), tok
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    dp_ax = decode_batch_spec(mesh)[0]
+    return jax.jit(
+        decode_token_step,
+        donate_argnums=(0,),
+        out_shardings=((cache_shardings(mesh), x_sh),
+                       NamedSharding(mesh, P(dp_ax))),
+    )
+
+
+def build_decode_fused_token(config: ModelConfig, mesh: Mesh, k: int):
+    """The fused K-step scan in token-feedback mode: identical trip
+    structure to ``build_decode_fused`` (lengths recomputed per trip
+    from the replicated inputs — same dp-reshard hazard, same fix) with
+    the greedy token quantisation between trips.  Returns ``(carry,
+    toks [k, B])``."""
+
+    def decode_fused_token(carry, params, table, active, remaining):
+        cache0, x0 = carry
+        lengths0 = cache0.lengths
+        act_i32 = active.astype(jnp.int32)
+
+        def step(c, _):
+            k_c, v_c, x, i = c
+            step_active = active & (i < remaining)
+            lengths_i = lengths0 + act_i32 * jnp.minimum(i, remaining)
+            (cache, _x2), y = _decode_step_math(
+                (KVCache(k_c, v_c, lengths_i), x), params, step_active,
+                config)
+            tok = jnp.argmax(y[:, 0, :], axis=-1).astype(jnp.int32)
+            x2 = jnp.take(table, tok, axis=0)[:, None, :].astype(x.dtype)
+            return (cache.k, cache.v, x2, i + 1), tok
+
+        (k_c, v_c, x, _i), toks = jax.lax.scan(
+            step, (cache0.k, cache0.v, x0, jnp.int32(0)), None, length=k)
+        lengths_f = lengths0 + act_i32 * jnp.minimum(jnp.int32(k),
+                                                     remaining)
+        return (KVCache(k_c, v_c, lengths_f), x), toks
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    dp_ax = decode_batch_spec(mesh)[0]
+    return jax.jit(
+        decode_fused_token,
+        donate_argnums=(0,),
+        out_shardings=((cache_shardings(mesh), x_sh),
+                       NamedSharding(mesh, P(None, dp_ax))),
+    )
+
+
+def build_verify_step(config: ModelConfig, mesh: Mesh, gamma: int):
+    """Jitted draft-and-verify target forward: the γ proposed tokens of
+    every slot run through ONE batched ``[max_batch, γ+1, H]``
+    ``_serve_block`` stack under the per-slot offset-causal mask
+    (``_verify_attention``) — one fused forward per verify unit, zero
+    per-draft-token dispatches or collectives (audited:
+    ``verify_step_expectation``).
+
+    Inputs: the donated ``(cache, x)`` carry, the token table, the
+    drafters' ``draft_ids [B, γ]``, ``active`` and ``remaining`` (each
+    slot's output-token budget).  Per layer, all γ+1 positions append
+    K/V at ``lengths + i`` via one-hot masked writes (the decode-step
+    append, γ+1 times), exactly as γ+1 sequential decode steps would.
+
+    Greedy acceptance: ``tok = argmax(y)`` gives the target's true
+    token at every position; the accepted prefix length is the run of
+    leading draft/target matches, and ``commits = min(accepted+1,
+    remaining)`` (the +1 is the verify's own bonus token — the target
+    output at the first mismatch position, whose input was still a
+    verified token).  New lengths advance by ``commits``; the rejected
+    suffix's cache entries are DEAD BY CONSTRUCTION — attention is
+    length-masked, and the next unit's writes land at the committed
+    lengths, overwriting every rejected position before any later
+    query's mask can reach it (asserted by the token-identity tests,
+    never copied or zeroed).  ``x'`` is the last committed token's
+    embedding, so the carry protocol is unchanged.
+
+    Returns ``(carry, tok [B, γ+1], commits [B])``; tok/commits stay
+    dp-sharded (no boundary gather — the host reads them at the unit's
+    sync)."""
+    n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+    g1 = gamma + 1
+
+    def verify_step(carry, params, table, draft_ids, active, remaining):
+        cache, x = carry
+        b_dim, s_max = cache.max_batch, cache.max_seq
+        nb, bs = cache.num_blocks, cache.block_size
+        lengths = cache.lengths
+        d_emb = jnp.take(table, draft_ids, axis=0).astype(x.dtype)
+        h0 = jnp.concatenate([x, d_emb], axis=1)        # [B, γ+1, H]
+        pos = jnp.arange(s_max)[None, :]                # [1, S]
+        offs = lengths[:, None] + jnp.arange(g1)[None, :]   # [B, γ+1]
+        valid = pos[:, None, :] <= offs[:, :, None]     # [B, γ+1, S]
+
+        def attention_step(q, k, v, cache_state):
+            k_l, v_l = cache_state
+            qh = _heads(q, n, d)                        # [B, n, γ+1, d]
+            k_new = k.reshape(b_dim, g1, kvh, d)
+            v_new = v.reshape(b_dim, g1, kvh, d)
+            k_flat = k_l.reshape(b_dim, s_max, kvh, d)
+            v_flat = v_l.reshape(b_dim, s_max, kvh, d)
+            # γ+1 one-hot appends at each slot's own running length —
+            # the decode-step masked write, unrolled over the verify
+            # positions (static γ, so this stays collective-free
+            # elementwise selects)
+            for i in range(g1):
+                m = ((pos == lengths[:, None] + i)
+                     & active[:, None])[..., None, None]
+                k_flat = jnp.where(m, k_new[:, i][:, None], k_flat)
+                v_flat = jnp.where(m, v_new[:, i][:, None], v_flat)
+            attn = _verify_attention(qh, k_flat, v_flat, valid)
+            return (attn.transpose(0, 2, 1, 3).reshape(b_dim, g1, n * d),
+                    (k_flat.reshape(b_dim, nb, bs, kvh, d),
+                     v_flat.reshape(b_dim, nb, bs, kvh, d)))
+
+        def body(h, layer_and_cache):
+            layer, k_l, v_l = layer_and_cache
+            return _serve_block(h, layer, config, attention_step,
+                                (k_l, v_l))
+
+        h, (k_new, v_new) = jax.lax.scan(
+            body, h0, (params["layers"], cache.k, cache.v)
+        )
+        y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        tok = jnp.argmax(y, axis=-1).astype(jnp.int32)  # [B, γ+1]
+        match = (tok[:, :gamma] == draft_ids).astype(jnp.int32)
+        accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+        commits = jnp.where(active,
+                            jnp.minimum(accepted + 1, remaining),
+                            0).astype(jnp.int32)
+        lengths_f = (lengths + commits).astype(jnp.int32)
+        last = jnp.take_along_axis(
+            tok, jnp.maximum(commits - 1, 0)[:, None], axis=1)[:, 0]
+        x_new = jnp.take(table, last, axis=0)[:, None, :].astype(x.dtype)
+        x_f = jnp.where(active[:, None, None], x_new, x)
+        return (KVCache(k_new, v_new, lengths_f), x_f), tok, commits
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    dp_ax = decode_batch_spec(mesh)[0]
+    return jax.jit(
+        verify_step,
+        donate_argnums=(0,),
+        out_shardings=((cache_shardings(mesh), x_sh),
+                       NamedSharding(mesh, P(dp_ax, None)),
+                       NamedSharding(mesh, P(dp_ax))),
+    )
+
+
+def build_draft_scan(config: ModelConfig, mesh: Mesh, gamma: int):
+    """Jitted draft-model proposal scan: γ greedy token-feedback decode
+    steps of the SHALLOW draft transformer over its own donated paged
+    cache plane — ``draft_scan(cache, params, table, x, lengths,
+    active) -> (cache, draft_ids [B, γ])``.
+
+    ``x`` is the TARGET's current carry input (the draft shares the
+    target's hidden size and token table, so the committed-token
+    embedding is the right draft input); ``lengths`` are the HOST'S
+    committed lengths, passed explicitly — this IS the draft plane's
+    rejection rollback: the cache's own lengths leaf (advanced by γ
+    last unit) is simply overridden, and entries past the committed
+    lengths are dead by the same length-mask construction as the
+    target's.  The ids stay on device (dp-sharded) and flow straight
+    into the verify step — no host round-trip in the draft-verify
+    chain."""
+
+    def draft_scan(cache, params, table, x, lengths, active):
+        act_i32 = active.astype(jnp.int32)
+
+        def step(c, _):
+            k_c, v_c, x_c, i = c
+            lengths_i = lengths + act_i32 * i
+            (cache_i, _x2), y = _decode_step_math(
+                (KVCache(k_c, v_c, lengths_i), x_c), params, active,
+                config)
+            tok = jnp.argmax(y[:, 0, :], axis=-1).astype(jnp.int32)
+            x2 = jnp.take(table, tok, axis=0)[:, None, :].astype(x_c.dtype)
+            return (cache_i.k, cache_i.v, x2, i + 1), tok
+
+        (k_c, v_c, _x, _i), toks = jax.lax.scan(
+            step, (cache.k, cache.v, x, jnp.int32(0)), None, length=gamma)
+        lengths_f = lengths + act_i32 * gamma
+        return KVCache(k_c, v_c, lengths_f), toks.T    # ids [B, γ]
+
+    dp_ax = decode_batch_spec(mesh)[0]
+    return jax.jit(
+        draft_scan,
+        donate_argnums=(0,),
+        out_shardings=(cache_shardings(mesh),
+                       NamedSharding(mesh, P(dp_ax, None))),
+    )
+
+
+def _ngram_propose(hist: list, gamma: int,
+                   max_ngram: int = 3) -> Optional[list]:
+    """Prompt-lookup / n-gram drafting (Saxena 2023): find the most
+    recent earlier occurrence of the history's trailing n-gram (n from
+    ``max_ngram`` down to 1) in ``hist`` (= the request's prompt token
+    ids + every committed token) and propose the γ ids that followed
+    it.  When the match sits d < γ positions back, the continuation
+    runs off the end of the history after d tokens — but a trailing
+    match at distance d means the history is locally d-periodic, so
+    the proposal extends CYCLICALLY through that period rather than
+    flat-padding (greedy feedback through a fixed table falls into
+    short cycles, and cyclic extension is what lets a γ≫d proposal
+    stay correct for the whole window).  Pure, deterministic function
+    of the history — drafter determinism from trace seeds is a test
+    invariant.  None = cold (no occurrence of even the last token):
+    the scheduler falls back to a plain decode unit."""
+    ln = len(hist)
+    for n in range(min(max_ngram, ln - 1), 0, -1):
+        key = hist[ln - n:]
+        for start in range(ln - n - 1, -1, -1):
+            if hist[start:start + n] == key:
+                cont = list(hist[start + n:start + n + gamma])
+                if len(cont) < gamma:
+                    d = len(cont)  # == distance back to the match
+                    cont += [cont[i % d] for i in range(d, gamma)]
+                return cont
+    return None
+
+
+def residual_distribution(p_target: np.ndarray,
+                          q_draft: np.ndarray) -> np.ndarray:
+    """The rejection-correction distribution of speculative SAMPLING
+    (Leviathan et al. 2023): ``norm(max(p - q, 0))``.  Degenerates to
+    ``p`` when ``q`` dominates it everywhere (rejection then has zero
+    probability, so the branch is never taken)."""
+    resid = np.maximum(np.asarray(p_target, np.float64)
+                       - np.asarray(q_draft, np.float64), 0.0)
+    z = resid.sum()
+    if z <= 0.0:
+        return np.asarray(p_target, np.float64)
+    return resid / z
+
+
+def speculative_sample(p_target: np.ndarray, q_draft: np.ndarray,
+                       draft_id: int,
+                       rng: np.random.Generator) -> tuple[int, bool]:
+    """One position of the residual-sampling correction — HOW the
+    equivalence gate weakens for sampled (temperature > 0) decode:
+    accept the drafted token with probability ``min(1, p/q)``; on
+    rejection, sample from ``residual_distribution(p, q)``.  The
+    composite law is exactly ``p`` (distribution-identity, pinned by
+    ``tests/test_speculative.py``), so sampled speculative decode is
+    distribution-identical — not token-identical — to the sequential
+    sampler.  The engine's serving path is greedy (argmax), which this
+    correction degenerates to as temperature -> 0; the helper documents
+    and tests the sampled contract without wiring a sampler through the
+    scheduler (docs/serving.md)."""
+    p = float(p_target[draft_id])
+    q = float(q_draft[draft_id])
+    accept_p = 1.0 if q <= 0.0 and p > 0.0 else (
+        min(1.0, p / q) if q > 0.0 else 0.0)
+    if rng.uniform() < accept_p:
+        return int(draft_id), True
+    resid = residual_distribution(p_target, q_draft)
+    return int(rng.choice(len(resid), p=resid)), False
+
+
 def _with_deadline(fn, deadline: Optional[float], label: str,
                    phase: str) -> Any:
     """Run ``fn()`` under the serving dispatch watchdog (the PR-5
@@ -897,6 +1363,10 @@ class _SlotState:
     tokens_done: int = 0
     admitted_s: float = 0.0
     first_token_s: float = 0.0
+    # adaptive speculation: this request's current verify γ (a ladder
+    # bucket) and its acceptance-rate EMA (-1 = no verify observed yet)
+    gamma_eff: int = 0
+    accept_ema: float = -1.0
 
 
 @dataclass
@@ -922,6 +1392,14 @@ class _RunStats:
     preempted_requests: int = 0
     deadline_shed: int = 0
     completed_past_deadline: int = 0
+    # speculative decoding (docs/serving.md, "Speculative decoding")
+    spec_verify_units: int = 0      # draft-and-verify dispatches
+    spec_fallback_units: int = 0    # cold-drafter plain-decode fallbacks
+    spec_proposed_tokens: int = 0   # γ per resident slot per verify
+    spec_accepted_tokens: int = 0   # drafts the target verify accepted
+    spec_commit_tokens: int = 0     # committed incl. the bonus token
+    spec_slot_verifies: int = 0     # slot-level verifies (for mean len)
+    spec_draft_s: float = 0.0       # host drafting / draft-scan wall
 
 
 class ServingEngine:
@@ -1013,6 +1491,63 @@ class ServingEngine:
         self._inject = jax.jit(_inject_token, donate_argnums=(0,))
         self._x_sharding = NamedSharding(mesh, decode_batch_spec(mesh))
         self._active_sharding = NamedSharding(mesh, P())
+        # -- speculative decoding (docs/serving.md) --
+        # token-feedback modes quantise decode through the greedy token
+        # table; the legacy jits above stay built (jax.jit is lazy, so
+        # an unused ladder costs nothing) and the "off" path is
+        # bit-for-bit untouched
+        self._token_mode = serving.speculation != "off"
+        # non-adaptive runs verify at exactly spec_gamma; adaptive runs
+        # need the whole back-off ladder compiled
+        self._spec_gammas: tuple[int, ...] = (
+            serving.spec_gammas if serving.spec_adaptive
+            else ((serving.spec_gamma,) if serving.spec_drafting else ()))
+        self._table: Optional[jax.Array] = None
+        self._decode_token = None
+        self._decode_fused_token: dict[int, Any] = {}
+        self._verify: dict[int, Any] = {}
+        self._draft_config: Optional[ModelConfig] = None
+        self._draft_params: Any = None
+        self._draft_prefill = None
+        self._draft_scan: dict[int, Any] = {}
+        if self._token_mode:
+            self._table = jax.device_put(
+                token_embedding_table(config.hidden_size, self._dtype),
+                NamedSharding(mesh, P()))
+            self._decode_token = build_decode_token_step(config, mesh)
+            self._decode_fused_token = {
+                k: build_decode_fused_token(config, mesh, k)
+                for k in self._fused_ks
+            }
+            self._inject_greedy = jax.jit(_inject_token_greedy,
+                                          donate_argnums=(0,))
+            dp_ax = decode_batch_spec(mesh)[0]
+            self._ids_sharding = NamedSharding(mesh, P(dp_ax, None))
+        if serving.spec_drafting:
+            self._verify = {g: build_verify_step(config, mesh, g)
+                            for g in self._spec_gammas}
+            self._spec_proposed = self.registry.labeled_counter(
+                "serve_spec_proposed_total", "drafter",
+                initial=("ngram", "draft-model"),
+                help="draft tokens proposed to the verify step, by drafter",
+            )
+            self._spec_accepted = self.registry.labeled_counter(
+                "serve_spec_accepted_total", "drafter",
+                initial=("ngram", "draft-model"),
+                help="draft tokens the target verify accepted, by drafter",
+            )
+        if serving.speculation == "draft-model":
+            self._draft_config = serving.draft_model_config(config)
+            # the draft model is the ENGINE's (never caller-supplied):
+            # derived deterministically from the seed so replays draft
+            # identically; sharded by the same ParallelismPlan
+            self._draft_params = init_params_sharded(
+                self._draft_config, jax.random.key(seed + 1), mesh)
+            self._draft_prefill = build_prefill(self._draft_config, mesh)
+            self._draft_scan = {
+                g: build_draft_scan(self._draft_config, mesh, g)
+                for g in self._spec_gammas
+            }
         self._t0 = time.perf_counter()
 
     # -- clock (monotonic, run-relative) -----------------------------------
@@ -1033,6 +1568,20 @@ class ServingEngine:
             self._x_sharding,
         )
         return (cache, x)
+
+    def _fresh_draft_cache(self) -> Optional[KVCache]:
+        """The draft model's own paged KV plane (same slot/block
+        geometry as the target's — both planes cover max_seq tokens per
+        slot — at the draft config's layer/kv-head dims).  None when no
+        draft model is configured, so every carry-reset site can assign
+        unconditionally."""
+        if self._draft_config is None:
+            return None
+        return create_kv_cache(
+            self._draft_config, self.serving.max_batch,
+            self.serving.num_blocks, self.serving.block_size,
+            mesh=self.mesh,
+        )
 
     def capture_device_traces(self, trace_root: Any) -> list[dict]:
         """Serving capture parity with the sweep engine's gated capture
@@ -1066,24 +1615,37 @@ class ServingEngine:
 
         if self._fast and self._fused_ks:
             k = min(self._fused_ks)
-            fused = self._decode_fused[k]
+            fused = (self._decode_fused_token[k] if self._token_mode
+                     else self._decode_fused[k])
 
-            def decode_fn(t):
-                return fused(t[0], self.params, t[1], t[2])
+            if self._token_mode:
+                def decode_fn(t):
+                    return fused(t[0], self.params, self._table, t[1],
+                                 t[2])
+            else:
+                def decode_fn(t):
+                    return fused(t[0], self.params, t[1], t[2])
 
             def decode_payload():
                 return (self._fresh_carry(), self._zero_active(),
                         self._zero_remaining())
 
-            label = f"serve_decode_fused_k{k}"
+            label = (f"serve_decode_fused_token_k{k}" if self._token_mode
+                     else f"serve_decode_fused_k{k}")
         else:
-            def decode_fn(t):
-                return self._decode(t[0], self.params, t[1])
+            if self._token_mode:
+                def decode_fn(t):
+                    return self._decode_token(t[0], self.params,
+                                              self._table, t[1])
+            else:
+                def decode_fn(t):
+                    return self._decode(t[0], self.params, t[1])
 
             def decode_payload():
                 return (self._fresh_carry(), self._zero_active())
 
-            label = "serve_decode_step"
+            label = ("serve_decode_token_step" if self._token_mode
+                     else "serve_decode_step")
         meta = obs_capture.capture_device_trace(
             decode_fn, decode_payload, trace_root, label=label)
         meta["phase"] = "decode"
@@ -1175,10 +1737,47 @@ class ServingEngine:
                     dummy[:, ci * chunk:(ci + 1) * chunk],
                     np.int32(0), np.int32(total))
             carry = (cache, carry[1])
-        carry = self._inject(carry, np.int32(0), y_last)
-        carry, _y = self._decode(carry, self.params, active)
         remaining = jax.device_put(
             jnp.zeros((cfg.max_batch,), jnp.int32), self._active_sharding)
+        if self._token_mode:
+            # token-feedback warms: the legacy inject/decode/fused jits
+            # are never dispatched in a token-mode run, so warming them
+            # would only burn compile time
+            carry, _tok = self._inject_greedy(carry, np.int32(0), y_last,
+                                              self._table)
+            carry, _tok = self._decode_token(carry, self.params,
+                                             self._table, active)
+            for k in self._fused_ks:
+                carry, _toks = self._decode_fused_token[k](
+                    carry, self.params, self._table, active, remaining)
+            for g in self._spec_gammas:
+                ids = jax.device_put(
+                    jnp.zeros((cfg.max_batch, g), jnp.int32),
+                    self._ids_sharding)
+                carry, _tok, _commits = self._verify[g](
+                    carry, self.params, self._table, ids, active,
+                    remaining)
+            if self._draft_config is not None:
+                dcache = self._fresh_draft_cache()
+                for b in buckets:
+                    dummy = request_embeddings(
+                        0, b, self.config.hidden_size,
+                        dtype=self._dtype, pad_to=b)
+                    dcache, _dy = self._draft_prefill(
+                        dcache, self._draft_params, dummy, np.int32(0),
+                        np.int32(b))
+                dlen = jax.device_put(
+                    jnp.zeros((cfg.max_batch,), jnp.int32),
+                    self._active_sharding)
+                for g in self._spec_gammas:
+                    dcache, _ids = self._draft_scan[g](
+                        dcache, self._draft_params, self._table,
+                        carry[1], dlen, active)
+                jax.block_until_ready(dcache.lengths)
+            jax.block_until_ready(carry[1])
+            return
+        carry = self._inject(carry, np.int32(0), y_last)
+        carry, _y = self._decode(carry, self.params, active)
         for k in self._fused_ks:
             carry, _ys = self._decode_fused[k](carry, self.params, active,
                                                remaining)
@@ -1275,6 +1874,23 @@ class ServingEngine:
                                     self._active_sharding)
         rejected_detail: list[dict[str, Any]] = []
         tokens_by_rid: dict[int, list[int]] = {}
+        # -- speculative decoding state (docs/serving.md) --
+        token_mode = self._token_mode
+        spec_on = cfg.spec_drafting
+        # per-rid committed token history (prompt ids + every committed
+        # token): the n-gram drafter's lookup context
+        hist: dict[int, list[int]] = {}
+        # the draft model's KV plane rides in a one-slot holder (the
+        # closures below rebind it at every dispatch / carry reset);
+        # its ledger mirrors the target's accounting — the draft plane
+        # has the same slot/block geometry, and its COMMITTED content
+        # tracks the target's exactly (draft writes past the committed
+        # length are dead by the length-mask construction)
+        draft_cache: list[Optional[KVCache]] = [self._fresh_draft_cache()]
+        draft_ledger = (BlockLedger(cfg.total_blocks, cfg.block_size)
+                        if draft_cache[0] is not None else None)
+        # run-level acceptance EMA (the metrics.prom gauge)
+        accept_ema_run = [-1.0]
         # per-request final outcome map (rid -> "completed" /
         # "rejected[reason]" / "failed[reason]" / "preempted") — the
         # thing kill-mid-trace ≡ uninterrupted equivalence is pinned on
@@ -1307,6 +1923,8 @@ class ServingEngine:
             the scan already masked the slot inactive)."""
             st = slots.pop(slot)
             ledger.free(slot)
+            if draft_ledger is not None:
+                draft_ledger.free(slot)
             active_np[slot] = False
             active_dirty[0] = True
             free_slots.append(slot)
@@ -1340,6 +1958,8 @@ class ServingEngine:
             matches the on-device state (docs/resilience.md)."""
             return {
                 "ledger": ledger.snapshot(),
+                "draft_ledger": (draft_ledger.snapshot()
+                                 if draft_ledger is not None else None),
                 "slots": {s: (st, st.tokens_done)
                           for s, st in slots.items()},
                 "free_slots": list(free_slots),
@@ -1349,6 +1969,8 @@ class ServingEngine:
 
         def restore_snapshot(snap: dict[str, Any]) -> None:
             ledger.restore(snap["ledger"])
+            if draft_ledger is not None:
+                draft_ledger.restore(snap["draft_ledger"])
             slots.clear()
             for s, (st, td) in snap["slots"].items():
                 st.tokens_done = td
@@ -1418,6 +2040,7 @@ class ServingEngine:
             fail_requests(unconfirmed, exc, "hung-dispatch")
             fail_resident(exc, "hung-dispatch")
             carry = self._fresh_carry()
+            draft_cache[0] = self._fresh_draft_cache()
             carry_resets[0] += 1
 
         def sync_one() -> None:
@@ -1443,7 +2066,21 @@ class ServingEngine:
             done_at = self._now()
             for st in unit["completions"]:
                 finish(st, done_at)
-            if self.capture_tokens:
+            if unit.get("tokens"):
+                # token-feedback unit: ys are the committed token ids
+                # themselves ([B] per-step, [k, B] fused) — the n-gram
+                # history extends from them even when capture is off
+                if cfg.speculation == "ngram" or self.capture_tokens:
+                    toks_np = np.asarray(unit["ys"])
+                    if toks_np.ndim == 1:   # per-step unit: [B]
+                        toks_np = toks_np[None]
+                    for row, _slot, rid, steps in unit["rows"]:
+                        ids = [int(t) for t in toks_np[:steps, row]]
+                        if cfg.speculation == "ngram" and rid in hist:
+                            hist[rid].extend(ids)
+                        if self.capture_tokens:
+                            tokens_by_rid.setdefault(rid, []).extend(ids)
+            elif self.capture_tokens:
                 ys_np = np.asarray(unit["ys"], np.float32)
                 if ys_np.ndim == 3:        # per-step unit: [B, 1, H]
                     ys_np = ys_np[None]
@@ -1500,9 +2137,15 @@ class ServingEngine:
                                           "serve-dispatch")
 
                 if k == 1:
-                    carry, ys = dispatch(
-                        lambda: self._decode(carry, self.params,
-                                             active_dev))
+                    if token_mode:
+                        carry, ys = dispatch(
+                            lambda: self._decode_token(
+                                carry, self.params, self._table,
+                                active_dev))
+                    else:
+                        carry, ys = dispatch(
+                            lambda: self._decode(carry, self.params,
+                                                 active_dev))
                     stats.single_steps += 1
                     for s in sorted(steps):
                         rows.append((s, s, slots[s].req.rid, 1))
@@ -1543,9 +2186,15 @@ class ServingEngine:
                         rem_np[s] = m
                     rem_dev = jax.device_put(jnp.asarray(rem_np),
                                              self._active_sharding)
-                    carry, ys = dispatch(
-                        lambda: self._decode_fused[k](
-                            carry, self.params, active_dev, rem_dev))
+                    if token_mode:
+                        carry, ys = dispatch(
+                            lambda: self._decode_fused_token[k](
+                                carry, self.params, self._table,
+                                active_dev, rem_dev))
+                    else:
+                        carry, ys = dispatch(
+                            lambda: self._decode_fused[k](
+                                carry, self.params, active_dev, rem_dev))
                     stats.fused_scans += 1
                     stats.fused_steps += k
                     self.registry.inc("serve_fused_scan_steps", k)
@@ -1570,6 +2219,8 @@ class ServingEngine:
                                     "injected serve-cache-torn: ledger/"
                                     "slot bookkeeping torn mid-unit")
                             ledger.append(s, m)
+                            if draft_ledger is not None:
+                                draft_ledger.append(s, m)
                             stats.generated_tokens += m
                             if st.tokens_done >= st.req.output_len:
                                 completions.append(s)
@@ -1598,7 +2249,7 @@ class ServingEngine:
                 if completions:
                     refresh_active()
                 inflight.append({"t0": t0, "ys": ys, "k_exec": k,
-                                 "rows": rows,
+                                 "rows": rows, "tokens": token_mode,
                                  "completions": done_states})
                 # a k==1 unit's y is the SAME logical value as the
                 # carry's x (decode_step returns ((cache, y), y)); on
@@ -1610,6 +2261,291 @@ class ServingEngine:
                 window = 1 if k == 1 else cfg.inflight_window
                 while len(inflight) >= window:
                     sync_one()
+
+        def spec_unit(g: int, drafts_np: np.ndarray,
+                      snap: dict[str, Any]) -> None:
+            """One draft-and-verify unit, committed: draft (host match
+            already in ``drafts_np`` for ngram; the draft-model scan
+            dispatches here), ONE batched target verify over the whole
+            resident batch, a synchronous commit read, and the
+            rollback-disciplined host bookkeeping.
+
+            A verify unit never rides the in-flight window: its host
+            accounting depends on the device's acceptance result, so it
+            syncs at its own boundary (the window was drained before
+            drafting — history and bookkeeping must be current).
+            Bookkeeping is optimistic-then-rollback: every slot is
+            first accounted the full γ+1 window (the fused-scan
+            discipline — outcomes known at dispatch time), and the
+            synced commits roll any shortfall back to the pre-dispatch
+            snapshot (PR-11's ledger snapshot/restore as the
+            rejection-rollback primitive) and replay the true counts.
+            The rejected suffix needs NO device cleanup: appended-but-
+            rejected cache positions sit past the committed lengths,
+            attention is length-masked, and the next unit's writes land
+            at the committed lengths — dead by construction (asserted
+            by the token-identity tests, never copied or zeroed)."""
+            nonlocal carry
+            refresh_active()
+            rows = [(s, slots[s].req.rid) for s in sorted(slots)]
+            rem_map = {s: slots[s].req.output_len - slots[s].tokens_done
+                       for s, _ in rows}
+            deadline = unit_deadline(g + 1)
+            t0 = time.perf_counter()
+            with spans.span("serve-verify", active=len(slots), gamma=g,
+                            drafter=cfg.speculation):
+                if inject.fire("serve-decode-fail"):
+                    # fires BEFORE any jit consumes the carry — a retry
+                    # re-dispatches from unchanged device state (same
+                    # contract as the decode unit's site)
+                    raise TransientFault(
+                        "injected serve-decode-fail at the verify "
+                        "dispatch boundary")
+
+                def dispatch(fn):
+                    def run():
+                        if inject.fire("serve-decode-hang"):
+                            time.sleep(inject.param("hang_seconds"))
+                        return fn()
+                    return _with_deadline(run, deadline,
+                                          f"verify[gamma={g}]",
+                                          "serve-dispatch")
+
+                rem_np = np.zeros((cfg.max_batch,), np.int32)
+                for s, _ in rows:
+                    rem_np[s] = rem_map[s]
+                rem_dev = jax.device_put(jnp.asarray(rem_np),
+                                         self._active_sharding)
+                if cfg.speculation == "draft-model":
+                    # the draft plane's rejection rollback IS this
+                    # lengths vector: the host's committed lengths
+                    # override the plane's own (advanced-by-γ) leaf,
+                    # and entries past them are dead by the same
+                    # length-mask construction as the target's
+                    lengths_np = np.zeros((cfg.max_batch,), np.int32)
+                    for s, _ in rows:
+                        st = slots[s]
+                        lengths_np[s] = (st.req.prompt_len
+                                         + st.tokens_done - 1)
+                    dlen = jax.device_put(jnp.asarray(lengths_np),
+                                          self._active_sharding)
+                    t_d = time.perf_counter()
+                    dcache, ids = dispatch(
+                        lambda: self._draft_scan[g](
+                            draft_cache[0], self._draft_params,
+                            self._table, carry[1], dlen, active_dev))
+                    draft_cache[0] = dcache
+                    # host dispatch wall only — the proposals stay on
+                    # device and flow straight into the verify
+                    stats.spec_draft_s += time.perf_counter() - t_d
+                else:
+                    ids = jax.device_put(jnp.asarray(drafts_np),
+                                         self._ids_sharding)
+                carry, tok, commits = dispatch(
+                    lambda: self._verify[g](
+                        carry, self.params, self._table, ids,
+                        active_dev, rem_dev))
+                commits_np = _with_deadline(
+                    lambda: np.asarray(commits), deadline,
+                    f"verify[gamma={g}]", "serve-sync")
+                t_ready = time.perf_counter()
+                dt = t_ready - max(t0, last_sync[0])
+                last_sync[0] = t_ready
+                # torn-protected bookkeeping (the decode unit's replay
+                # discipline): the device result is in hand, so every
+                # replay is pure host recomputation, never a re-dispatch
+                book_attempt = 0
+                while True:
+                    completions: list[int] = []
+                    try:
+                        for s, _rid in rows:
+                            st = slots[s]
+                            opt = min(g + 1, rem_map[s])
+                            st.tokens_done += opt
+                            ledger.append(s, opt)
+                            if draft_ledger is not None:
+                                draft_ledger.append(s, opt)
+                            stats.generated_tokens += opt
+                        if inject.fire("serve-cache-torn"):
+                            raise TransientFault(
+                                "injected serve-cache-torn: ledger/slot "
+                                "bookkeeping torn mid-verify")
+                        if any(int(commits_np[s]) != min(g + 1, rem_map[s])
+                               for s, _ in rows):
+                            # rejection rollback: restore the
+                            # pre-dispatch snapshot, replay TRUE commits
+                            restore_snapshot(snap)
+                            for s, _rid in rows:
+                                st = slots[s]
+                                m = int(commits_np[s])
+                                st.tokens_done += m
+                                ledger.append(s, m)
+                                if draft_ledger is not None:
+                                    draft_ledger.append(s, m)
+                                stats.generated_tokens += m
+                        for s, _rid in rows:
+                            if (slots[s].tokens_done
+                                    >= slots[s].req.output_len):
+                                completions.append(s)
+                        break
+                    except (TransientFault, CorruptStats) as e:
+                        restore_snapshot(snap)
+                        if book_attempt >= cfg.max_dispatch_retries:
+                            raise RuntimeError(
+                                "ledger/slot bookkeeping kept failing "
+                                "after the verify unit completed on "
+                                "device"
+                            ) from e
+                        book_attempt += 1
+                        stats.retries += 1
+                        self._retry_counter["bookkeeping"] += 1
+                        if self.journal is not None:
+                            self.journal.event(
+                                "dispatch-retry", phase="bookkeeping",
+                                attempt=book_attempt, error=str(e))
+                        time.sleep(cfg.retry_backoff_s
+                                   * (2 ** (book_attempt - 1)))
+                # committed: per-slot acceptance stats, adaptive γ,
+                # history/capture, then completions at THIS sync point
+                stats.decode_steps += 1
+                stats.decode_units += 1
+                stats.spec_verify_units += 1
+                self.registry.inc("serve_decode_steps", 1)
+                stats.decode_step_s.append(dt)
+                step_ema[0] = (dt if step_ema[0] == 0.0
+                               else 0.5 * step_ema[0] + 0.5 * dt)
+                drafter = cfg.speculation
+                ladder = self._spec_gammas
+                unit_acc = 0
+                tok_np = (np.asarray(tok)
+                          if (drafter == "ngram" or self.capture_tokens)
+                          else None)
+                for s, rid in rows:
+                    m = int(commits_np[s])
+                    acc = max(m - 1, 0)
+                    unit_acc += acc
+                    stats.spec_slot_verifies += 1
+                    stats.spec_proposed_tokens += g
+                    stats.spec_accepted_tokens += acc
+                    stats.spec_commit_tokens += m
+                    self._spec_proposed[drafter] += g
+                    self._spec_accepted[drafter] += acc
+                    for _ in range(m):
+                        stats.per_token_s.append(dt / m)
+                    self._event("spec-verify", rid, gamma=g,
+                                accepted=acc, committed=m)
+                    st = slots[s]
+                    if cfg.spec_adaptive:
+                        rate = acc / g
+                        st.accept_ema = (rate if st.accept_ema < 0
+                                         else 0.5 * st.accept_ema
+                                         + 0.5 * rate)
+                        pos = (ladder.index(st.gamma_eff)
+                               if st.gamma_eff in ladder
+                               else len(ladder) - 1)
+                        if st.accept_ema < 0.25 and pos > 0:
+                            st.gamma_eff = ladder[pos - 1]
+                        elif (st.accept_ema > 0.75
+                              and pos < len(ladder) - 1):
+                            st.gamma_eff = ladder[pos + 1]
+                    if tok_np is not None:
+                        ids_host = [int(t) for t in tok_np[s, :m]]
+                        if drafter == "ngram" and rid in hist:
+                            hist[rid].extend(ids_host)
+                        if self.capture_tokens:
+                            tokens_by_rid.setdefault(rid, []).extend(
+                                ids_host)
+                unit_rate = unit_acc / (g * len(rows)) if rows else 0.0
+                accept_ema_run[0] = (
+                    unit_rate if accept_ema_run[0] < 0
+                    else 0.5 * accept_ema_run[0] + 0.5 * unit_rate)
+                self.registry.set_gauge(
+                    "serve_spec_acceptance_ema", accept_ema_run[0],
+                    help="EMA of per-verify-unit draft acceptance rate")
+                done_states = [release(s) for s in completions]
+                if completions:
+                    refresh_active()
+                done_at = self._now()
+                for st in done_states:
+                    finish(st, done_at)
+
+        def dispatch_spec() -> bool:
+            """One draft-and-verify unit over the resident batch, with
+            the decode path's full recovery ladder.  Returns False when
+            the drafter is cold (no n-gram hit for ANY resident slot) —
+            the caller falls back to the plain token decode unit, so
+            speculation COMPOSES with decode_horizon/inflight_window
+            instead of replacing them."""
+            nonlocal carry
+            # history and host bookkeeping must be current before
+            # drafting (fallback token units may still be in flight)
+            drain()
+            if not slots:
+                return True     # the drain's completions emptied the batch
+            ladder = self._spec_gammas
+            if cfg.spec_adaptive:
+                g_want = max(st.gamma_eff for st in slots.values())
+            else:
+                g_want = cfg.spec_gamma
+            g = ladder[0]
+            for cand in ladder:
+                if cand <= g_want:
+                    g = cand
+            drafts_np = np.zeros((cfg.max_batch, g), np.int32)
+            if cfg.speculation == "ngram":
+                t_d = time.perf_counter()
+                any_hit = False
+                for s in sorted(slots):
+                    prop = _ngram_propose(hist.get(slots[s].req.rid, []),
+                                          g)
+                    if prop is not None:
+                        drafts_np[s] = prop
+                        any_hit = True
+                stats.spec_draft_s += time.perf_counter() - t_d
+                if not any_hit:
+                    stats.spec_fallback_units += 1
+                    return False
+            snap = take_snapshot()
+            attempt = 0
+            while True:
+                try:
+                    spec_unit(g, drafts_np, snap)
+                    return True
+                except (TransientFault, CorruptStats) as e:
+                    restore_snapshot(snap)
+                    if attempt >= cfg.max_dispatch_retries:
+                        fail_resident(e, "dispatch-failed")
+                        return True
+                    attempt += 1
+                    stats.retries += 1
+                    self._retry_counter["decode"] += 1
+                    if self.journal is not None:
+                        self.journal.event("dispatch-retry",
+                                           phase="decode",
+                                           attempt=attempt,
+                                           error=str(e))
+                    time.sleep(cfg.retry_backoff_s * (2 ** (attempt - 1)))
+                except DeadlineExceeded as e:
+                    restore_snapshot(snap)
+                    stats.hung_dispatches += 1
+                    self.registry.inc("serve_hung_dispatches")
+                    drain()
+                    fail_resident(e, "hung-dispatch")
+                    carry = self._fresh_carry()
+                    draft_cache[0] = self._fresh_draft_cache()
+                    carry_resets[0] += 1
+                    return True
+                except Exception as e:  # noqa: BLE001 — fail closed
+                    restore_snapshot(snap)
+                    try:
+                        drain()
+                    except Exception:  # noqa: BLE001
+                        inflight.clear()
+                    fail_resident(e, "dispatch-failed")
+                    carry = self._fresh_carry()
+                    draft_cache[0] = self._fresh_draft_cache()
+                    carry_resets[0] += 1
+                    return True
 
         def dispatch_decode(max_k: Optional[int] = None) -> None:
             """One decode unit over the resident batch: a single step,
@@ -1632,6 +2568,15 @@ class ServingEngine:
             carry."""
             nonlocal carry
             refresh_active()
+            if spec_on and max_k is None:
+                # draft-and-verify first; a cold n-gram drafter falls
+                # through to a plain token decode unit below (the
+                # chunked-prefill interleave's max_k=1 also bypasses
+                # drafting — a verify's γ+1 commit window would re-create
+                # the head-of-line blocking the interleave removes)
+                if dispatch_spec():
+                    return
+                refresh_active()
             rem = {s: slots[s].req.output_len - slots[s].tokens_done
                    for s in sorted(slots)}
             # next event: the earliest completion while anything is (or
@@ -1700,6 +2645,7 @@ class ServingEngine:
                     drain()
                     fail_resident(e, "hung-dispatch")
                     carry = self._fresh_carry()
+                    draft_cache[0] = self._fresh_draft_cache()
                     carry_resets[0] += 1
                     return
                 except Exception as e:  # noqa: BLE001 — fail closed
@@ -1714,6 +2660,7 @@ class ServingEngine:
                         inflight.clear()
                     fail_resident(e, "dispatch-failed")
                     carry = self._fresh_carry()
+                    draft_cache[0] = self._fresh_draft_cache()
                     carry_resets[0] += 1
                     return
 
@@ -1798,6 +2745,16 @@ class ServingEngine:
                     cache, y_last = self._prefill(
                         carry[0], self.params, x_prompt,
                         np.int32(slot), np.int32(req.prompt_len))
+                    if self._draft_prefill is not None:
+                        # the draft plane is prefilled at admission from
+                        # the SAME prompt embeddings (idempotent masked
+                        # writes, so the retry wrapper covers it); its
+                        # cost is billed as prefill — the admission
+                        # price of the draft model
+                        dcache, _dy = self._draft_prefill(
+                            draft_cache[0], self._draft_params, x_prompt,
+                            np.int32(slot), np.int32(req.prompt_len))
+                        draft_cache[0] = dcache
                     jax.block_until_ready(y_last)
                     dt = time.perf_counter() - t0
                 carry = (cache, carry[1])
@@ -1837,6 +2794,8 @@ class ServingEngine:
             engine continues on a fresh carry."""
             nonlocal carry
             ledger.free(slot)
+            if draft_ledger is not None:
+                draft_ledger.free(slot)
             free_slots.append(slot)
             free_slots.sort()
             fail_requests([_SlotState(req=req, tokens_done=0)], exc,
@@ -1844,6 +2803,7 @@ class ServingEngine:
             if not isinstance(exc, InjectedFault):
                 fail_resident(exc, "dispatch-failed")
                 carry = self._fresh_carry()
+                draft_cache[0] = self._fresh_draft_cache()
 
         self._t0 = time.perf_counter()
         last_sync[0] = self._t0
@@ -1944,19 +2904,42 @@ class ServingEngine:
                         req = queue.popleft()
                         slot = free_slots.pop(0)
                         ledger.reserve(slot, req.total_tokens)
+                        if draft_ledger is not None:
+                            draft_ledger.reserve(slot, req.total_tokens)
                         try:
                             bucket, y_last, dt = prefill_dispatch(req,
                                                                   slot)
                         except Exception as e:  # noqa: BLE001 — closed
                             fail_admission(req, slot, e)
                             continue
-                        carry = self._inject(carry, np.int32(slot),
-                                             y_last)
+                        first_id = -1
+                        if token_mode:
+                            # greedy token inject: argmax on device, a
+                            # 4-byte id to host — the history seed AND
+                            # the equivalence capture in one transfer
+                            carry, first_tok = self._inject_greedy(
+                                carry, np.int32(slot), y_last,
+                                self._table)
+                            first_id = int(first_tok)
+                        else:
+                            carry = self._inject(carry, np.int32(slot),
+                                                 y_last)
                         ledger.append(slot, req.prompt_len)
+                        if draft_ledger is not None:
+                            draft_ledger.append(slot, req.prompt_len)
                         t_first = self._now()
                         st = _SlotState(req=req, tokens_done=1,
                                         admitted_s=now,
-                                        first_token_s=t_first)
+                                        first_token_s=t_first,
+                                        gamma_eff=cfg.spec_gamma)
+                        if cfg.speculation == "ngram":
+                            # prompt-lookup context: the prompt's own
+                            # token-id view (pure numpy, admission-time)
+                            # plus the prefill's first committed token
+                            hist[req.rid] = prompt_token_ids(
+                                req.seed, req.prompt_len,
+                                self.config.hidden_size,
+                                period=req.prompt_period) + [first_id]
                         slots[slot] = st
                         active_np[slot] = True
                         active_dirty[0] = True
@@ -1969,7 +2952,8 @@ class ServingEngine:
                             # to host per admission, never the whole
                             # hidden state (host-transfer-in-loop)
                             tokens_by_rid.setdefault(req.rid, []).append(
-                                int(jnp.argmax(y_last)))
+                                first_id if token_mode
+                                else int(jnp.argmax(y_last)))
                         self._event("request-prefill", req.rid, slot=slot,
                                     bucket=bucket,
                                     ttft_s=round(t_first - req.arrival_s, 6))
@@ -2096,6 +3080,23 @@ class ServingEngine:
                 "single_steps": stats.single_steps,
                 "prefill_chunks": stats.prefill_chunks,
                 "compacted_scans": stats.compacted_scans,
+            },
+            "speculation": {
+                "mode": cfg.speculation,
+                "gamma": cfg.spec_gamma,
+                "adaptive": cfg.spec_adaptive,
+                "verify_units": stats.spec_verify_units,
+                "fallback_units": stats.spec_fallback_units,
+                "proposed_tokens": stats.spec_proposed_tokens,
+                "accepted_tokens": stats.spec_accepted_tokens,
+                "acceptance_rate": (
+                    stats.spec_accepted_tokens
+                    / stats.spec_proposed_tokens
+                    if stats.spec_proposed_tokens else 0.0),
+                "mean_accepted_len": (
+                    stats.spec_commit_tokens / stats.spec_slot_verifies
+                    if stats.spec_slot_verifies else 0.0),
+                "draft_overhead_s": stats.spec_draft_s,
             },
             "resilience": {
                 "retries": stats.retries,

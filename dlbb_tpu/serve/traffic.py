@@ -53,7 +53,12 @@ class Request:
     already blown it (``request-rejected[reason=deadline]``), and a
     request that completes past it is counted
     ``completed_past_deadline`` (docs/serving.md).  Absent (None) means
-    no deadline — the pre-deadline trace schema is unchanged."""
+    no deadline — the pre-deadline trace schema is unchanged.
+    ``prompt_period`` tiles the request's prompt embeddings from a
+    seeded motif of that many positions (``data/synthetic.py``) — the
+    repeating-structure variant that gives the n-gram drafter real
+    lookup structure; None (the default) keeps the original fully
+    random prompts and the original serialisation."""
 
     rid: int
     arrival_s: float
@@ -61,6 +66,7 @@ class Request:
     output_len: int
     seed: int
     deadline_s: Optional[float] = None
+    prompt_period: Optional[int] = None
 
     @property
     def total_tokens(self) -> int:
@@ -101,11 +107,12 @@ class TrafficTrace:
             "kind": self.kind,
             "seed": self.seed,
             "params": dict(self.params),
-            # deadline-free requests serialise exactly as the original
-            # v1 schema (no key), so committed traces stay byte-stable
+            # optional fields serialise only when set, so committed
+            # pre-feature traces stay byte-stable
             "requests": [
                 {k: v for k, v in asdict(r).items()
-                 if k != "deadline_s" or v is not None}
+                 if k not in ("deadline_s", "prompt_period")
+                 or v is not None}
                 for r in self.requests
             ],
         }
@@ -223,6 +230,7 @@ def generate_trace(
     period_s: float = 4.0,
     depth: float = 0.8,
     deadline_s: Optional[float] = None,
+    prompt_period: Optional[int] = None,
 ) -> TrafficTrace:
     """Generate a seeded, replayable trace.
 
@@ -230,8 +238,11 @@ def generate_trace(
     ``bursty``, the mean of the sinusoid for ``diurnal``); length bounds
     are inclusive.  ``deadline_s`` stamps every request with that SLO
     (seconds from arrival; None = no deadlines, the original schema).
-    The same ``(kind, num_requests, seed, params)`` always yields the
-    identical trace.
+    ``prompt_period`` stamps every request with a repeating-structure
+    prompt (motif of that many positions tiled to the prompt length —
+    the speculative-decoding bench's trace variant; None = fully random
+    prompts, the original schema).  The same ``(kind, num_requests,
+    seed, params)`` always yields the identical trace.
     """
     if kind not in TRACE_KINDS:
         raise ValueError(
@@ -265,10 +276,17 @@ def generate_trace(
                 f"deadline_s must be > 0 seconds, got {deadline_s}"
             )
         params["deadline_s"] = deadline_s
+    if prompt_period is not None:
+        if prompt_period < 1:
+            raise ValueError(
+                f"prompt_period must be >= 1, got {prompt_period}"
+            )
+        params["prompt_period"] = prompt_period
     requests = tuple(
         Request(rid=i, arrival_s=float(arrivals[i]),
                 prompt_len=int(prompts[i]), output_len=int(outputs[i]),
-                seed=int(seeds[i]), deadline_s=deadline_s)
+                seed=int(seeds[i]), deadline_s=deadline_s,
+                prompt_period=prompt_period)
         for i in range(num_requests)
     )
     return TrafficTrace(kind=kind, seed=seed, params=params,

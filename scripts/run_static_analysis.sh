@@ -199,6 +199,21 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve_fastpath.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py -q \
     -m serve_chaos_smoke -p no:cacheprovider
 
+# spec_smoke (docs/serving.md, "Speculative decoding"): draft-and-verify
+# multi-token decode — n-gram and draft-model drafters, per-step and
+# fused, must stay TOKEN-IDENTICAL to the per-step greedy oracle on a
+# seeded repeating-structure mini-trace (speculation buys forwards,
+# never different results), with spec-verify journal events and
+# acceptance counters exported.  The HLO-side contract (one fused
+# (γ+1)-wide verify forward with per-layer psums only — NO per-draft-
+# token collectives or trip-weighted loops — and the 1-layer draft
+# plane's own donated cache) is enforced by `analyze all` above via the
+# serve/engine.py::{verify_step,draft_scan,decode_fused_token} targets,
+# and `analyze diff` against the committed baselines makes a per-token
+# collective inside the verify body a CI failure — zero suppressions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q \
+    -m spec_smoke -p no:cacheprovider
+
 # compressed-collective smoke (docs/compression.md): int8/fp8 allreduce_q
 # mini-sweep through the real engine + one compressed train step whose
 # losses track the uncompressed run — the HLO-side compression proof

@@ -119,6 +119,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "spec_smoke: speculative-decoding smoke — n-gram and "
+        "draft-model draft-and-verify engines must stay token-identical "
+        "to the per-step greedy oracle on a seeded repeating-structure "
+        "mini-trace, with spec-verify journal events and acceptance "
+        "counters exported (tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )
