@@ -1,8 +1,8 @@
 """comm-lint: static verification that benchmarks match their parallelism
 plan.
 
-Four passes (see docs/analysis.md + docs/schedule_audit.md +
-docs/memory_audit.md for the rule catalogues):
+Five passes (see docs/analysis.md + docs/schedule_audit.md +
+docs/memory_audit.md + docs/numerics.md for the rule catalogues):
 
 - ``hlo``      — lower + compile every registered benchmark computation on
   the current (usually ``--simulate N`` CPU) mesh and audit the post-SPMD
@@ -18,22 +18,30 @@ docs/memory_audit.md for the rule catalogues):
   transient-replicated-buffer spike gate, the serving cache
   cross-check, and ``hbm_headroom`` feasibility per cost tier
   (``memory_audit``).
+- ``numerics`` — the dtype-flow numerics auditor over the same modules:
+  low-precision accumulation with analytic error bounds, silent f32
+  upcasts under a bf16 policy, quantise roundtrips, nondeterministic fp
+  wire reductions, precision-policy conformance, and convert churn —
+  fusion bodies included (``numerics_audit``; the fp64 shadow
+  cross-check lives in ``numerics_shadow``).
 - ``lint``     — AST rules over ``dlbb_tpu/`` and ``scripts/`` for host
   syncs and wall-clock reads in timed regions, undonated train-step jits,
   jit-in-loop recompile hazards, per-iteration host transfers in loops,
   unsorted set iteration, and non-atomic artifact writes
   (``source_lint``).
 
-Plus the regression-baseline gate over the schedule + memory passes:
+Plus the regression-baseline gate over the schedule + memory + numerics
+passes:
 
 - ``snapshot`` — write per-target baselines to ``stats/analysis/baselines``
   (refuses while the audit itself has error findings).
 - ``diff``     — compare a fresh audit against the committed baselines and
   fail on unexplained growth (>10 % critical path / wire / peak memory /
-  largest transient, new collective kind).
+  largest transient, new collective kind, new low-precision accumulation
+  site / numerics error-bound drift).
 
 CLI: ``python -m dlbb_tpu.cli analyze
-[hlo|lint|schedule|memory|all|snapshot|diff] --simulate 8``.  Exit codes
+[hlo|lint|schedule|memory|numerics|all|snapshot|diff] --simulate 8``.  Exit codes
 are a pinned contract (``findings.EXIT_*``): 0 = clean, 1 = findings,
 2 = the analyzer crashed.
 """
@@ -57,14 +65,22 @@ _HLO_PASSES = {
     "hlo": ("hlo",),
     "schedule": ("schedule",),
     "memory": ("memory",),
-    "all": ("hlo", "schedule", "memory"),
-    "snapshot": ("hlo", "schedule", "memory"),
-    "diff": ("hlo", "schedule", "memory"),
+    "numerics": ("numerics",),
+    "all": ("hlo", "schedule", "memory", "numerics"),
+    "snapshot": ("hlo", "schedule", "memory", "numerics"),
+    "diff": ("hlo", "schedule", "memory", "numerics"),
 }
 
 # memory-meta keys folded into the per-target baseline snapshots next to
 # the schedule keys (the one committed gate file per target)
 _MEMORY_BASELINE_KEYS = ("peak_live_bytes", "max_transient_bytes")
+# numerics-meta keys folded the same way (already numerics_-prefixed in
+# the meta, so they cannot collide with schedule keys)
+_NUMERICS_BASELINE_KEYS = (
+    "numerics_low_precision_sites",
+    "numerics_convert_count",
+    "numerics_max_rel_error_bound",
+)
 
 
 def run_analysis(
@@ -143,6 +159,11 @@ def _run_analysis(
             for key in _MEMORY_BASELINE_KEYS:
                 if key in mem:
                     dest[key] = mem[key]
+        for target, num in report.numerics.items():
+            dest = report.schedule.setdefault(target, {})
+            for key in _NUMERICS_BASELINE_KEYS:
+                if key in num:
+                    dest[key] = num[key]
 
     if output and report.memory:
         # the observability surface (`analyze memory --output DIR`,
@@ -160,6 +181,24 @@ def _run_analysis(
         if verbose:
             print(f"[analyze] memory report written to {path} "
                   "(manifest + metrics.prom updated)")
+
+    if output and report.numerics:
+        from dlbb_tpu.analysis.numerics_audit import write_numerics_artifacts
+
+        path = write_numerics_artifacts(report.numerics, output)
+        if verbose:
+            print(f"[analyze] numerics report written to {path} "
+                  "(manifest + metrics.prom updated)")
+
+    if output:
+        # per-pass finding counts as gauges (obs/export.analysis_metrics):
+        # suppression/violation drift stays observable across PRs even
+        # when the run is clean — all five passes always report a sample
+        from dlbb_tpu.obs.calibration import METRICS_NAME, _fold_metrics
+        from dlbb_tpu.obs.export import analysis_metrics
+
+        _fold_metrics(analysis_metrics(report),
+                      Path(output) / METRICS_NAME)
 
     base_dir = Path(baselines) if baselines else DEFAULT_BASELINE_DIR
     if which == "snapshot":

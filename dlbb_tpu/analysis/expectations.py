@@ -254,6 +254,27 @@ class TargetExpectation:
                         replicated state pytree or an undonated carry
                         blows it even when every wire instruction looks
                         right.
+    policy_dtype:       the target's declared compute/storage dtype in
+                        HLO terms ("f32" / "bf16" / "f16"; None = no
+                        declared policy).  The numerics auditor's
+                        anchor (``numerics_audit.py``): under a low
+                        policy, sizeable f32 collectives / while
+                        carries are ``silent-upcast``; params or
+                        accumulators BELOW policy precision (or any
+                        f64) are ``policy-conformance``.  Derive it
+                        from ``ModelConfig.dtype`` with
+                        :func:`policy_dtype_for` so the declared policy
+                        can never drift from the model config the
+                        target actually built.
+    expect_bitwise_reproducible: the target claims bitwise-identical
+                        results across runs/topologies.  Any fp
+                        add-reduction on the wire (all-reduce /
+                        reduce-scatter) makes that claim unsound —
+                        the reduction order is backend-scheduled —
+                        so the numerics auditor errors
+                        (``nondeterministic-reduction``).  Off by
+                        default: no benchmark target claims it; the
+                        count is still recorded per target.
     donated_bytes_expected: analytic per-device bytes the program's
                         donated input buffers must sum to, within
                         ``donated_bytes_tolerance`` (relative).  The
@@ -276,6 +297,30 @@ class TargetExpectation:
     max_peak_bytes: Optional[int] = None
     donated_bytes_expected: Optional[int] = None
     donated_bytes_tolerance: float = 0.10
+    policy_dtype: Optional[str] = None
+    expect_bitwise_reproducible: bool = False
+
+
+# ``ModelConfig.dtype`` / numpy-style dtype name -> HLO element type, the
+# translation every audit target uses to declare its precision policy
+_HLO_POLICY_DTYPE = {
+    "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+    "float64": "f64",
+    "f32": "f32", "bf16": "bf16", "f16": "f16", "f64": "f64",
+}
+
+
+def policy_dtype_for(dtype: str) -> str:
+    """The HLO element type a ``ModelConfig.dtype`` string declares —
+    the single translation point between model configs and the numerics
+    auditor's ``policy_dtype``."""
+    try:
+        return _HLO_POLICY_DTYPE[dtype]
+    except KeyError:
+        raise ValueError(
+            f"no HLO policy dtype for {dtype!r}; known: "
+            f"{sorted(_HLO_POLICY_DTYPE)}"
+        ) from None
 
 
 def op_expectation(op_name: str, payload_bytes_per_rank: int,
@@ -296,6 +341,8 @@ def op_expectation(op_name: str, payload_bytes_per_rank: int,
         required_any=set(required_any),
         min_required=spec.get("min_required", 1),
         max_bytes_per_instr=int(payload_bytes_per_rank * slack),
+        # registry micro-op payloads are f32 (comm/ops.py make_payload)
+        policy_dtype="f32",
     )
 
 
@@ -404,12 +451,19 @@ def compressed_op_expectation(op_name: str, p: int, num_elements: int,
         ),
         max_total_wire_bytes=compression_wire_ceiling(
             baseline, analytic, ratio=ratio),
+        # the compressed micro-ops carry bf16 payloads (the baseline the
+        # ratio contract is priced against) — the numerics pass verifies
+        # nothing f32-sized crosses the quantised ring (the scale side
+        # channel stays under its byte floor)
+        policy_dtype="bf16",
     )
 
 
 def decode_scan_expectation(dp: int, tp: int, k: int,
                             act_bytes: int,
-                            slack: float = 1.25) -> TargetExpectation:
+                            slack: float = 1.25,
+                            policy_dtype: Optional[str] = "f32",
+                            ) -> TargetExpectation:
     """Expectation for the FUSED multi-step decode scan
     (``serve/engine.py::build_decode_fused``): the scan body may contain
     only the per-token tp collectives (``plan_expected_kinds(decode=
@@ -437,12 +491,15 @@ def decode_scan_expectation(dp: int, tp: int, k: int,
         min_required=k,
         max_bytes_per_instr=int(act_bytes * slack),
         expect_donation=True,
+        policy_dtype=policy_dtype,
     )
 
 
 def verify_step_expectation(dp: int, tp: int, gamma: int,
                             act_bytes: int,
-                            slack: float = 1.25) -> TargetExpectation:
+                            slack: float = 1.25,
+                            policy_dtype: Optional[str] = "f32",
+                            ) -> TargetExpectation:
     """Expectation for the speculative-decoding verify step
     (``serve/engine.py::build_verify_step``): the γ drafted tokens plus
     the carry token run through ONE batched ``[max_batch, γ+1, H]``
@@ -468,6 +525,7 @@ def verify_step_expectation(dp: int, tp: int, gamma: int,
         min_required=1,
         max_bytes_per_instr=int(act_bytes * (gamma + 1) * slack),
         expect_donation=True,
+        policy_dtype=policy_dtype,
     )
 
 
@@ -497,4 +555,5 @@ def overlap_op_expectation(p: int, chunk_bytes: int,
         min_required=p - 1,
         max_bytes_per_instr=int(chunk_bytes * slack),
         expect_overlap=True,
+        policy_dtype="f32",
     )

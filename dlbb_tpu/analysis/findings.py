@@ -31,7 +31,7 @@ EXIT_CRASH = 2
 class Finding:
     """One comm-lint violation.
 
-    pass_name: "hlo" or "lint".
+    pass_name: "hlo", "lint", "schedule", "memory" or "numerics".
     rule:      stable rule identifier (see docs/analysis.md catalogue).
     severity:  "error" findings fail the run; "warning" findings do not.
     target:    audit-target name (hlo) or repo-relative file path (lint).
@@ -84,6 +84,10 @@ class AnalysisReport:
     # transients; memory_audit.analyze_memory) — feeds the same baseline
     # snapshots as the schedule pass
     memory: dict[str, dict] = field(default_factory=dict)
+    # target name -> numerics meta (reduction-site table / error bounds /
+    # convert counts; numerics_audit.analyze_numerics) — its numerics_*
+    # gate keys fold into the same baseline snapshots
+    numerics: dict[str, dict] = field(default_factory=dict)
 
     def extend(self, other: "AnalysisReport") -> None:
         self.findings.extend(other.findings)
@@ -93,6 +97,7 @@ class AnalysisReport:
         self.skipped_targets.extend(other.skipped_targets)
         self.schedule.update(other.schedule)
         self.memory.update(other.memory)
+        self.numerics.update(other.numerics)
 
     @property
     def errors(self) -> list[Finding]:
@@ -114,6 +119,7 @@ class AnalysisReport:
             "findings": [f.to_dict() for f in self.findings],
             "schedule": self.schedule,
             "memory": self.memory,
+            "numerics": self.numerics,
             "summary": {
                 "errors": len(self.errors),
                 "warnings": len(self.warnings),
@@ -144,6 +150,8 @@ class AnalysisReport:
                if self.schedule else "")
             + (f", {len(self.memory)} memory report(s)"
                if self.memory else "")
+            + (f", {len(self.numerics)} numerics report(s)"
+               if self.numerics else "")
             + (f", {len(self.skipped_targets)} target(s) skipped"
                if self.skipped_targets else "")
         )

@@ -104,6 +104,18 @@ def audit_target(
         )
         findings.extend(mem_findings)
         meta["memory"] = mem_meta
+    if "numerics" in passes:
+        from dlbb_tpu.analysis.numerics_audit import analyze_numerics
+
+        num_findings, num_meta = analyze_numerics(
+            module, exp, target.name,
+            num_devices=max(1, target.min_devices),
+            # price silent-upcast carries against the memory pass's peak
+            # when both passes ride the same lowering (`analyze all`)
+            peak_live_bytes=meta.get("memory", {}).get("peak_live_bytes"),
+        )
+        findings.extend(num_findings)
+        meta["numerics"] = num_meta
     if "hlo" not in passes:
         return findings, meta
 
@@ -1292,6 +1304,8 @@ def run_hlo_audit(
             report.schedule[target.name] = _meta["schedule"]
         if "memory" in _meta:
             report.memory[target.name] = _meta["memory"]
+        if "numerics" in _meta:
+            report.numerics[target.name] = _meta["numerics"]
         if verbose:
             status = "FAIL" if findings else "ok"
             sched = _meta.get("schedule")
@@ -1310,6 +1324,10 @@ def run_hlo_audit(
             if mem is not None:
                 extra += (f", peak "
                           f"{mem['peak_live_bytes'] / 1024:.1f}KiB")
+            num = _meta.get("numerics")
+            if num is not None:
+                extra += (f", err<="
+                          f"{num['numerics_max_rel_error_bound']:.2g}")
             print(f"[hlo] {target.name}: {status} "
                   f"({n_coll} collective(s){extra})")
     return report

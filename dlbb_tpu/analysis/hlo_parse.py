@@ -215,6 +215,87 @@ class HloModule:
                 yield comp, instr
 
 
+def call_sites(
+    module: HloModule,
+) -> dict[str, list[tuple[HloComputation, HloInstruction]]]:
+    """Reverse call map: callee computation name -> every (caller
+    computation, calling instruction) pair that references it.
+
+    The parser links calls downward only (``HloInstruction.called``); any
+    walk that needs to step *out* of a fusion body / loop region — e.g.
+    resolving a fusion parameter to the tensor the caller actually passed
+    — needs this back-edge table.  Fusion computations normally have
+    exactly one caller; while bodies/conditions share one ``while``."""
+    sites: dict[str, list[tuple[HloComputation, HloInstruction]]] = {}
+    for comp, instr in module.all_instructions():
+        for _role, callee in instr.called:
+            sites.setdefault(callee, []).append((comp, instr))
+    return sites
+
+
+def resolve_producers(
+    module: HloModule,
+    comp: HloComputation,
+    operand_name: str,
+    sites: Optional[dict[str, list[tuple[HloComputation,
+                                         HloInstruction]]]] = None,
+    max_hops: int = 8,
+) -> list[tuple[HloComputation, HloInstruction]]:
+    """The instruction(s) that actually produce ``%operand_name`` as seen
+    from ``comp``, looking THROUGH fusion boundaries in both directions:
+
+    - a ``fusion``/``call`` instruction resolves to its body's root;
+    - a fusion-body ``parameter`` resolves to the matching positional
+      call-site operand in every caller.
+
+    A same-computation ``by_name`` lookup stops dead at either boundary —
+    which is exactly where the interesting dtype transitions live (XLA
+    fuses convert chains and bf16 accumulator arithmetic into fusion
+    bodies).  Ascent is positional, so it is only taken for real call-like
+    sites (``fusion``/``call``); loop-region parameters (while body /
+    condition, branch computations) are NOT crossed — stepping out of a
+    while body conflates loop iterations.  Returns de-duplicated
+    (computation, instruction) pairs; empty when the name cannot be
+    resolved inside ``max_hops`` boundary crossings."""
+    if sites is None:
+        sites = call_sites(module)
+    out: list[tuple[HloComputation, HloInstruction]] = []
+    emitted: set[tuple[str, str]] = set()
+    seen: set[tuple[str, str]] = set()
+    work: list[tuple[HloComputation, str, int]] = [(comp, operand_name, 0)]
+    while work:
+        c, name, hops = work.pop()
+        if (c.name, name) in seen:
+            continue
+        seen.add((c.name, name))
+        instr = c.by_name().get(name)
+        if instr is None:
+            continue
+        if instr.opcode in ("fusion", "call") and hops < max_hops:
+            for role, callee in instr.called:
+                body = module.computations.get(callee) \
+                    if role == "calls" else None
+                if body is not None and body.root is not None:
+                    work.append((body, body.root.name, hops + 1))
+            continue
+        if (instr.opcode == "parameter" and not c.is_entry
+                and instr.parameter_number is not None and hops < max_hops):
+            ascended = False
+            for caller, site in sites.get(c.name, []):
+                if site.opcode not in ("fusion", "call"):
+                    continue
+                idx = instr.parameter_number
+                if idx < len(site.operands):
+                    work.append((caller, site.operands[idx], hops + 1))
+                    ascended = True
+            if ascended:
+                continue
+        if (c.name, instr.name) not in emitted:
+            emitted.add((c.name, instr.name))
+            out.append((c, instr))
+    return out
+
+
 # ---------------------------------------------------------------------------
 # low-level text helpers
 # ---------------------------------------------------------------------------

@@ -1,0 +1,697 @@
+"""Pass 5 — static numerics auditor (dtype flow / precision policy).
+
+The byte auditor proves *what* a lowered program sends, the schedule
+auditor *when*, the memory auditor *how much HBM* — this pass proves the
+program computes in the *precision* its target declares.  Over the same
+parsed post-SPMD module (``hlo_parse.parse_module``) it runs a dtype-flow
+analysis: every accumulation site (``dot`` contractions, add-combiner
+``reduce``), every collective payload, every ``convert``, every while
+carry — including the instructions XLA moved into fusion bodies, reached
+through ``hlo_parse.resolve_producers`` (bf16 accumulator arithmetic and
+convert chains live almost exclusively there).
+
+Error-bound model (docs/numerics.md): summing ``n`` terms in a dtype with
+unit roundoff ``u`` (``u = 2^-p``, ``p`` = significand bits incl. the
+hidden bit: f32 24, bf16 8, f16 11) bounds the result's relative error —
+against ``sum(|x_i|)`` — by ``(n-1)·u`` for sequential accumulation and
+``ceil(log2 n)·u`` for the tree order XLA actually emits.  A bf16
+accumulator over n=4096 elements is therefore up to ``4095·2^-8 ≈ 16``
+relative — total loss — where f32 stays ``< 2.5e-4``; the fp64 shadow
+cross-check (``numerics_shadow.py``) replays flagged shapes empirically
+against a float64 reference to confirm the bound is real, not
+theoretical.
+
+Rules (all findings carry the analytic details):
+
+- ``low-precision-accumulation`` — a bf16/f16 accumulator on a dot or
+  add-reduce over ``>= LOW_PRECISION_ACCUM_FLOOR`` elements, with the
+  sequential and tree bounds per reduction shape.
+- ``silent-upcast``       — under a declared bf16/f16 policy
+  (``TargetExpectation.policy_dtype``), an f32/f64 tensor crossing a
+  collective or resident in a while carry: doubled wire / HBM the plan
+  never priced, reported in extra bytes against the memory auditor's
+  ``peak_live_bytes`` when available.
+- ``quantise-roundtrip``  — a dequantise (narrow->fp convert) feeding
+  straight back into a quantise (fp->narrow convert) through nothing but
+  scaling/layout ops: the roundtrip did no arithmetic work and only
+  re-rounded.  The compression kernels' legitimate requantise always
+  accumulates between the two (``comm/compression.py`` ring hops), so
+  they stay clean.
+- ``nondeterministic-reduction`` — an fp all-reduce / reduce-scatter
+  whose replica-group reduction order is backend-scheduled: counted per
+  target always (meta), an error only when the target claims bitwise
+  reproducibility (``expect_bitwise_reproducible``).
+- ``policy-conformance``  — params / activations / accumulators disagree
+  with the declared ``ModelConfig`` precision policy: any f64 in the
+  module, a sizeable parameter stored below policy precision, or a
+  small accumulator below policy (large ones are
+  ``low-precision-accumulation``'s job — f32 master copies / moments
+  ABOVE a low policy are always legal mixed-precision practice and are
+  priced by ``silent-upcast`` instead).
+- ``convert-churn``       — redundant convert chains XLA failed to fold:
+  an identity convert, or an ``A -> wider -> A`` roundtrip whose
+  intermediate has no other consumer (a *narrowing* middle —
+  ``f32 -> bf16 -> f32``, ``f32 -> s8 -> f32`` — is a deliberate
+  precision clamp / quantisation-error probe and is never flagged).
+
+Per-target meta feeds the committed baseline gate exactly like the
+memory pass: ``numerics_low_precision_sites`` /
+``numerics_convert_count`` / ``numerics_max_rel_error_bound`` fold into
+the ``stats/analysis/baselines`` snapshots and ``analyze diff`` errors
+on drift (``schedule_audit.diff_baselines``).
+
+Pure text/graph analysis — importable WITHOUT jax (only the lowering in
+``hlo_audit`` and the shadow cross-check need a backend).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from math import ceil, log2, prod
+from pathlib import Path
+from typing import Any, Optional, Union
+
+from dlbb_tpu.analysis.expectations import TargetExpectation
+from dlbb_tpu.analysis.findings import (
+    SEVERITY_ERROR,
+    Finding,
+)
+from dlbb_tpu.analysis.hlo_parse import (
+    _DTYPE_BYTES,
+    _array_bytes,
+    HloComputation,
+    HloInstruction,
+    HloModule,
+    call_sites,
+    parse_module,
+    resolve_producers,
+)
+
+NUMERICS_REPORT_SCHEMA = "dlbb_numerics_audit_v1"
+NUMERICS_REPORT_NAME = "numerics_audit.json"
+
+# significand precision in bits, hidden bit included — unit roundoff is
+# 2^-p (f32: 2^-24, bf16: 2^-8, f16: 2^-11)
+SIGNIFICAND_BITS = {
+    "f64": 53, "f32": 24, "f16": 11, "bf16": 8,
+    "f8e4m3fn": 4, "f8e4m3": 4, "f8e5m2": 3,
+}
+LOW_PRECISION_DTYPES = ("bf16", "f16")
+# wire dtypes of the quantised collectives (plus the fp8 arithmetic types
+# before _to_wire's bitcast) — a convert to/from one of these is a
+# quantise/dequantise edge for the roundtrip rule
+QUANT_DTYPES = ("s8", "u8", "f8e4m3fn", "f8e4m3", "f8e5m2")
+
+# an accumulation shorter than this is not worth a finding even in bf16
+# (error bound < ~2 ulp of the result); every seeded fixture sits far
+# above, every real add-reduce in the repo far below
+LOW_PRECISION_ACCUM_FLOOR = 512
+# f32 payloads under a bf16 policy smaller than this are side channels
+# (quantisation scales, loss scalars) — legal mixed-precision practice
+UPCAST_BYTES_FLOOR = 4096
+# parameters below policy precision smaller than this are ignored
+# (scalar epsilons, counters)
+POLICY_BYTES_FLOOR = 1024
+
+# ops a value passes through unchanged-enough for roundtrip tracing:
+# unary layout/rounding ops follow their single operand; clamp follows
+# its middle (data) operand; select follows both branches; binary
+# arithmetic follows the strictly-larger operand (the smaller one is a
+# broadcast scale/bias).  On an EQUAL-size pair, multiply/divide still
+# pass (an elementwise scale — broadcast scales arrive full-size in
+# optimised HLO) but add/subtract/max/min ABORT: an equal-size combine
+# is real accumulation, the thing that makes a requantise legitimate
+_PASS_UNARY = frozenset((
+    "broadcast", "reshape", "bitcast", "bitcast-convert", "copy",
+    "transpose", "slice", "pad", "negate", "abs", "floor", "ceil",
+    "round-nearest-even", "round-nearest-afz",
+))
+_BIN_SCALE = frozenset((
+    "multiply", "divide", "add", "subtract", "maximum", "minimum",
+))
+_BIN_PASS_EQUAL = frozenset(("multiply", "divide"))
+
+
+def unit_roundoff(dtype: str) -> Optional[float]:
+    """``2^-p`` for a known fp dtype, None otherwise."""
+    bits = SIGNIFICAND_BITS.get(dtype)
+    return 2.0 ** -bits if bits else None
+
+
+def accumulation_error_bounds(n: int, dtype: str) -> tuple[float, float]:
+    """(sequential, tree) worst-case relative error bounds — against
+    ``sum(|x_i|)`` — for summing ``n`` terms in ``dtype``: ``(n-1)·u``
+    and ``ceil(log2 n)·u`` (standard first-order floating summation
+    analysis; Higham 2002 §4.2)."""
+    u = unit_roundoff(dtype) or 0.0
+    if n <= 1:
+        return 0.0, 0.0
+    return (n - 1) * u, ceil(log2(n)) * u
+
+
+def _is_fp(dtype: Optional[str]) -> bool:
+    return dtype in SIGNIFICAND_BITS
+
+
+def _precision(dtype: str) -> int:
+    return SIGNIFICAND_BITS.get(dtype, 0)
+
+
+def _elems(shape: tuple[int, ...]) -> int:
+    return int(prod(shape)) if shape else 1
+
+
+def _loc(comp: HloComputation, instr: HloInstruction) -> str:
+    loc = f"{comp.name}/%{instr.name}"
+    if instr.source:
+        loc += f" ({instr.source})"
+    return loc
+
+
+def _combiner_opcodes(module: HloModule, instr: HloInstruction) -> set[str]:
+    """Opcodes of the instruction's ``to_apply`` region (reduce /
+    all-reduce combiner) minus parameters — {"add"} for a sum."""
+    ops: set[str] = set()
+    for role, callee in instr.called:
+        if role != "to_apply":
+            continue
+        comp = module.computations.get(callee)
+        if comp is not None:
+            ops |= {i.opcode for i in comp.instructions
+                    if i.opcode != "parameter"}
+    return ops
+
+
+def _reduction_sites(module: HloModule) -> list[dict[str, Any]]:
+    """Every fp accumulation in the module — dot contractions and
+    add-combiner reduces, fusion bodies included — with the reduction
+    length and both analytic error bounds."""
+    sites: list[dict[str, Any]] = []
+    for comp, instr in module.all_instructions():
+        n = 0
+        kind = None
+        if instr.opcode == "dot" and _is_fp(instr.dtype):
+            kind = "dot"
+            if instr.operand_arrays:
+                lhs_shape = instr.operand_arrays[0][1]
+                n = int(prod(
+                    lhs_shape[d] for d in instr.lhs_contracting_dims
+                    if d < len(lhs_shape)
+                )) if instr.lhs_contracting_dims else 1
+        elif (instr.opcode == "reduce" and _is_fp(instr.dtype)
+                and "add" in _combiner_opcodes(module, instr)):
+            kind = "reduce"
+            if instr.operand_arrays:
+                n = _elems(instr.operand_arrays[0][1]) \
+                    // max(_elems(instr.shape), 1)
+        if kind is None or n <= 1:
+            continue
+        bound_seq, bound_tree = accumulation_error_bounds(n, instr.dtype)
+        sites.append({
+            "kind": kind,
+            "dtype": instr.dtype,
+            "elements": n,
+            "bound_sequential": bound_seq,
+            "bound_tree": bound_tree,
+            "location": _loc(comp, instr),
+            "op_name": instr.op_name,
+            "execution_count": comp.execution_count,
+        })
+    return sites
+
+
+def _data_operands(instr: HloInstruction) -> Optional[list[str]]:
+    """The operand names a roundtrip trace may follow through ``instr``,
+    or None when the op does real work (accumulation, contraction,
+    communication) and the trace must abort."""
+    op = instr.opcode
+    if op in _PASS_UNARY:
+        return list(instr.operands[:1])
+    if op == "clamp":
+        return [instr.operands[1]] if len(instr.operands) >= 2 else None
+    if op == "select":
+        return list(instr.operands[1:3])
+    if op in _BIN_SCALE:
+        if len(instr.operand_arrays) >= 2:
+            e0 = _elems(instr.operand_arrays[0][1])
+            e1 = _elems(instr.operand_arrays[1][1])
+            if e0 > e1:
+                return [instr.operands[0]]
+            if e1 > e0:
+                return [instr.operands[1]]
+            if op in _BIN_PASS_EQUAL:
+                # elementwise scale: either side may carry the payload
+                # (the scale path dead-ends at a constant/iota)
+                return list(instr.operands[:2])
+            return None  # equal-size combine: genuine accumulation
+        return list(instr.operands[:1])
+    return None
+
+
+def _find_dequant(
+    module: HloModule,
+    comp: HloComputation,
+    quantise: HloInstruction,
+    sites: dict,
+    max_steps: int = 64,
+) -> Optional[tuple[HloComputation, HloInstruction]]:
+    """Walk backwards from a quantise convert through pass-through ops
+    (crossing fusion boundaries); return the dequantise convert that
+    feeds it with no arithmetic work in between, or None."""
+    work: list[tuple[HloComputation, HloInstruction]] = []
+    seen: set[tuple[str, str]] = set()
+
+    def push(c: HloComputation, names: list[str]) -> None:
+        for name in names:
+            for c2, producer in resolve_producers(module, c, name, sites):
+                work.append((c2, producer))
+
+    push(comp, list(quantise.operands[:1]))
+    steps = 0
+    while work and steps < max_steps:
+        c, instr = work.pop()
+        steps += 1
+        if (c.name, instr.name) in seen:
+            continue
+        seen.add((c.name, instr.name))
+        if instr.opcode == "convert":
+            src = instr.operand_arrays[0][0] if instr.operand_arrays else ""
+            if src in QUANT_DTYPES and _is_fp(instr.dtype):
+                return c, instr
+            continue  # any other convert changes meaning: abort this path
+        follow = _data_operands(instr)
+        if follow is None:
+            continue
+        push(c, follow)
+    return None
+
+
+def _consumer_counts(module: HloModule) -> dict[str, dict[str, int]]:
+    """Per computation: instruction name -> number of operand references
+    (how many times the value is consumed within its computation)."""
+    counts: dict[str, dict[str, int]] = {}
+    for comp in module.computations.values():
+        c = counts.setdefault(comp.name, {})
+        for instr in comp.instructions:
+            for name in instr.operands:
+                c[name] = c.get(name, 0) + 1
+    return counts
+
+
+def analyze_numerics(
+    hlo: Union[str, HloModule],
+    expectation: TargetExpectation,
+    target: str,
+    num_devices: int = 1,
+    peak_live_bytes: Optional[int] = None,
+    top_n: int = 8,
+) -> tuple[list[Finding], dict[str, Any]]:
+    """Audit one lowered module's dtype flow against its declared
+    precision policy.  Returns (findings, meta); meta carries the
+    baseline-gate keys (``numerics_*``) and the top-N reduction-site
+    table the shadow cross-check replays."""
+    module = parse_module(hlo) if isinstance(hlo, str) else hlo
+    findings: list[Finding] = []
+    sites_map = call_sites(module)
+    policy = expectation.policy_dtype
+    policy_prec = _precision(policy) if policy else 0
+
+    fp_dtypes: set[str] = set()
+    for _comp, instr in module.all_instructions():
+        for d, _s in instr.arrays:
+            if _is_fp(d):
+                fp_dtypes.add(d)
+
+    # -- accumulation sites: low-precision-accumulation + the error-bound
+    #    meta the baseline gate and the fp64 shadow cross-check consume
+    sites = _reduction_sites(module)
+    low_precision_sites = 0
+    max_bound_tree = 0.0
+    max_elems = 0
+    for site in sites:
+        max_elems = max(max_elems, site["elements"])
+        max_bound_tree = max(max_bound_tree, site["bound_tree"])
+        if (site["dtype"] in LOW_PRECISION_DTYPES
+                and site["elements"] >= LOW_PRECISION_ACCUM_FLOOR):
+            low_precision_sites += 1
+            n, dt = site["elements"], site["dtype"]
+            findings.append(Finding(
+                pass_name="numerics", rule="low-precision-accumulation",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"{dt} accumulator on a {site['kind']} over {n} "
+                    f"elements: worst-case relative error "
+                    f"{site['bound_sequential']:.3g} sequential / "
+                    f"{site['bound_tree']:.3g} tree "
+                    f"(vs {accumulation_error_bounds(n, 'f32')[0]:.3g} "
+                    "in f32) — accumulate in f32 "
+                    "(preferred_element_type / an explicit upcast) and "
+                    "round the result"
+                ),
+                location=site["location"],
+                details=dict(site),
+            ))
+
+    # -- silent-upcast: f32/f64 where a bf16/f16 policy never priced it
+    if policy in LOW_PRECISION_DTYPES:
+        policy_bytes = _DTYPE_BYTES[policy]
+        for comp, instr in module.all_instructions():
+            if instr.kind and not instr.is_done:
+                payload, dtype, shape = instr.collective_payload()
+                if dtype in ("f32", "f64") and payload >= UPCAST_BYTES_FLOOR:
+                    extra = payload - payload * policy_bytes \
+                        // _DTYPE_BYTES[dtype]
+                    findings.append(Finding(
+                        pass_name="numerics", rule="silent-upcast",
+                        severity=SEVERITY_ERROR, target=target,
+                        message=(
+                            f"{dtype} payload ({payload} B) crosses a "
+                            f"{instr.kind} under a declared {policy} "
+                            f"policy — {extra} B/device of wire per "
+                            "execution the plan never priced; cast to "
+                            f"{policy} before the collective or declare "
+                            "the upcast in the expectation"
+                        ),
+                        location=_loc(comp, instr),
+                        details={
+                            "kind": instr.kind, "dtype": dtype,
+                            "payload_bytes": payload,
+                            "extra_bytes": extra,
+                            "execution_count": comp.execution_count,
+                        },
+                    ))
+            if instr.opcode == "while":
+                for d, s in instr.arrays:
+                    b = _array_bytes(d, s)
+                    if d in ("f32", "f64") and b >= UPCAST_BYTES_FLOOR:
+                        extra = b - b * policy_bytes // _DTYPE_BYTES[d]
+                        details: dict[str, Any] = {
+                            "dtype": d, "carry_bytes": b,
+                            "extra_bytes": extra,
+                        }
+                        pct = ""
+                        if peak_live_bytes:
+                            details["peak_live_bytes"] = peak_live_bytes
+                            pct = (f" ({extra / peak_live_bytes:.1%} of "
+                                   "the audited peak_live_bytes)")
+                        findings.append(Finding(
+                            pass_name="numerics", rule="silent-upcast",
+                            severity=SEVERITY_ERROR, target=target,
+                            message=(
+                                f"{d} while-carry element ({b} B) is "
+                                "HBM-resident across every trip under a "
+                                f"declared {policy} policy — {extra} B "
+                                f"of unpriced state{pct}; carry the "
+                                f"{policy} representation and upcast "
+                                "inside the body"
+                            ),
+                            location=_loc(comp, instr),
+                            details=details,
+                        ))
+
+    # -- quantise-roundtrip: dequantise feeding straight back into
+    #    quantise with no arithmetic in between
+    for comp, instr in module.all_instructions():
+        if instr.opcode != "convert" or instr.dtype not in QUANT_DTYPES:
+            continue
+        src = instr.operand_arrays[0][0] if instr.operand_arrays else ""
+        if not _is_fp(src):
+            continue
+        hit = _find_dequant(module, comp, instr, sites_map)
+        if hit is not None:
+            dq_comp, dq = hit
+            findings.append(Finding(
+                pass_name="numerics", rule="quantise-roundtrip",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"dequantise ({dq.operand_arrays[0][0]} -> "
+                    f"{dq.dtype} at {_loc(dq_comp, dq)}) feeds straight "
+                    f"back into quantise ({src} -> {instr.dtype}) "
+                    "through scaling/layout ops only — the roundtrip "
+                    "does no arithmetic work and adds a rounding; keep "
+                    "the wire representation across the hop"
+                ),
+                location=_loc(comp, instr),
+                details={
+                    "quantise": _loc(comp, instr),
+                    "dequantise": _loc(dq_comp, dq),
+                    "wire_dtype": instr.dtype,
+                },
+            ))
+
+    # -- nondeterministic-reduction: fp reduction order on the wire
+    nondet = 0
+    for comp, instr in module.all_instructions():
+        if instr.kind not in ("all-reduce", "reduce-scatter") \
+                or instr.is_done:
+            continue
+        _payload, dtype, _shape = instr.collective_payload()
+        combiner = _combiner_opcodes(module, instr)
+        if _is_fp(dtype) and (instr.group_size or 0) > 1 \
+                and ("add" in combiner or not combiner):
+            nondet += 1
+            if expectation.expect_bitwise_reproducible:
+                findings.append(Finding(
+                    pass_name="numerics",
+                    rule="nondeterministic-reduction",
+                    severity=SEVERITY_ERROR, target=target,
+                    message=(
+                        f"fp {dtype} {instr.kind} over "
+                        f"{instr.group_size} replicas: the reduction "
+                        "order is backend-scheduled, so results are not "
+                        "bitwise reproducible across runs/topologies — "
+                        "the target claims bitwise reproducibility "
+                        "(expect_bitwise_reproducible); drop the claim "
+                        "or reduce in integer/fixed-point"
+                    ),
+                    location=_loc(comp, instr),
+                    details={
+                        "kind": instr.kind, "dtype": dtype,
+                        "group_size": instr.group_size,
+                    },
+                ))
+
+    # -- policy-conformance: params / small accumulators / any f64
+    if policy:
+        f64_locs = [
+            _loc(comp, instr)
+            for comp, instr in module.all_instructions()
+            if any(d == "f64" for d, _s in instr.arrays)
+        ]
+        if f64_locs:
+            findings.append(Finding(
+                pass_name="numerics", rule="policy-conformance",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"{len(f64_locs)} f64 instruction(s) in a module "
+                    f"whose declared policy is {policy} — a host-side "
+                    "float64 literal / astype leaked into the jitted "
+                    "program (see the float64-literal-in-jit lint); "
+                    f"first: {f64_locs[0]}"
+                ),
+                location=f64_locs[0],
+                details={"count": len(f64_locs),
+                         "locations": f64_locs[:top_n]},
+            ))
+        entry = module.entry_computation()
+        for instr in (entry.instructions if entry is not None else []):
+            if instr.opcode != "parameter":
+                continue
+            for d, s in instr.arrays:
+                b = _array_bytes(d, s)
+                if (_is_fp(d) and _precision(d) < policy_prec
+                        and b >= POLICY_BYTES_FLOOR):
+                    findings.append(Finding(
+                        pass_name="numerics", rule="policy-conformance",
+                        severity=SEVERITY_ERROR, target=target,
+                        message=(
+                            f"parameter %{instr.name} stores {b} B as "
+                            f"{d}, below the declared {policy} policy — "
+                            "params/activations must carry at least "
+                            "policy precision (f32 master copies above "
+                            "a low policy are fine; storage below it "
+                            "is silent quantisation)"
+                        ),
+                        location=_loc(entry, instr),
+                        details={"dtype": d, "bytes": b,
+                                 "policy": policy},
+                    ))
+        for site in sites:
+            if (_precision(site["dtype"]) < policy_prec
+                    and site["elements"] < LOW_PRECISION_ACCUM_FLOOR):
+                findings.append(Finding(
+                    pass_name="numerics", rule="policy-conformance",
+                    severity=SEVERITY_ERROR, target=target,
+                    message=(
+                        f"{site['dtype']} accumulator on a "
+                        f"{site['kind']} under a declared {policy} "
+                        "policy (short reduction, "
+                        f"n={site['elements']}) — accumulators must "
+                        "carry at least policy precision"
+                    ),
+                    location=site["location"],
+                    details=dict(site, policy=policy),
+                ))
+
+    # -- convert-churn: identity converts and widening roundtrips
+    consumers = _consumer_counts(module)
+    convert_count = 0
+    for comp, instr in module.all_instructions():
+        if instr.opcode != "convert":
+            continue
+        convert_count += max(comp.execution_count, 1)
+        src = instr.operand_arrays[0][0] if instr.operand_arrays else None
+        if src is None:
+            continue
+        if src == instr.dtype:
+            findings.append(Finding(
+                pass_name="numerics", rule="convert-churn",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"identity convert {src} -> {instr.dtype}: a "
+                    "dead cast XLA failed to fold"
+                ),
+                location=_loc(comp, instr),
+                details={"chain": [src, instr.dtype]},
+            ))
+            continue
+        for c2, inner in resolve_producers(
+                module, comp, instr.operands[0], sites_map):
+            if inner.opcode != "convert" or not inner.operand_arrays:
+                continue
+            gsrc = inner.operand_arrays[0][0]
+            mid = inner.dtype
+            if not (gsrc == instr.dtype and _is_fp(gsrc) and _is_fp(mid)
+                    and _precision(mid) >= _precision(gsrc)):
+                continue
+            # a narrowing middle is a deliberate precision clamp; a
+            # widening middle consumed elsewhere is a shared upcast —
+            # only a single-use widening roundtrip is pure churn
+            uses = consumers.get(c2.name, {}).get(inner.name, 0)
+            if inner.is_root and not c2.is_entry:
+                for caller, site in sites_map.get(c2.name, []):
+                    uses += consumers.get(caller.name, {}) \
+                        .get(site.name, 0)
+            if uses > 1:
+                continue
+            findings.append(Finding(
+                pass_name="numerics", rule="convert-churn",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"redundant convert chain {gsrc} -> {mid} -> "
+                    f"{instr.dtype}: the widening intermediate has no "
+                    "other consumer, so the roundtrip is a no-op pair "
+                    "of casts XLA failed to fold"
+                ),
+                location=_loc(comp, instr),
+                details={"chain": [gsrc, mid, instr.dtype],
+                         "intermediate": _loc(c2, inner)},
+            ))
+
+    sites_sorted = sorted(
+        sites, key=lambda s: (s["bound_tree"], s["elements"]),
+        reverse=True,
+    )
+    meta: dict[str, Any] = {
+        "numerics_schema": NUMERICS_REPORT_SCHEMA,
+        "policy_dtype": policy,
+        "fp_dtypes": sorted(fp_dtypes),
+        "reduction_sites": len(sites),
+        "max_reduction_elems": max_elems,
+        "nondeterministic_reductions": nondet,
+        "numerics_low_precision_sites": low_precision_sites,
+        "numerics_convert_count": convert_count,
+        "numerics_max_rel_error_bound": max_bound_tree,
+        "sites": sites_sorted[:top_n],
+    }
+    return findings, meta
+
+
+# ---------------------------------------------------------------------------
+# manifest / Prometheus surface (`analyze numerics --output DIR`)
+# ---------------------------------------------------------------------------
+
+
+def numerics_metrics(numerics: dict[str, dict], registry=None):
+    """The numerics audit as Prometheus gauges — per-target worst error
+    bound, low-precision site count and convert count, next to the
+    memory/calibration gauges on the same scrape dashboard."""
+    from dlbb_tpu.obs.export import MetricsRegistry
+
+    registry = registry or MetricsRegistry()
+    for target in sorted(numerics):
+        meta = numerics[target]
+        registry.set_gauge(
+            "analysis_numerics_max_rel_error_bound",
+            meta.get("numerics_max_rel_error_bound", 0.0),
+            help="worst analytic tree-order accumulation error bound "
+                 "(relative, vs sum|x_i|) over the target's fp "
+                 "reduction sites",
+            target=target,
+        )
+        registry.set_gauge(
+            "analysis_numerics_low_precision_sites",
+            meta.get("numerics_low_precision_sites", 0),
+            help="bf16/f16 accumulation sites at or above the "
+                 "LOW_PRECISION_ACCUM_FLOOR",
+            target=target,
+        )
+        registry.set_gauge(
+            "analysis_numerics_convert_count",
+            meta.get("numerics_convert_count", 0),
+            help="execution-weighted convert instructions in the "
+                 "lowered module",
+            target=target,
+        )
+    registry.set_gauge("analysis_numerics_targets", len(numerics),
+                       help="targets the numerics audit covered")
+    return registry
+
+
+def write_numerics_artifacts(numerics: dict[str, dict],
+                             out_dir: "str | Path") -> Path:
+    """Write the per-target numerics report under ``out_dir`` and merge
+    the aggregate into ``sweep_manifest.json`` + ``metrics.prom``
+    without clobbering co-located exports (the memory auditor's
+    convention)."""
+    from dlbb_tpu.obs.calibration import METRICS_NAME, _fold_metrics
+    from dlbb_tpu.utils.config import atomic_write_text, save_json
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    report = {
+        "schema": NUMERICS_REPORT_SCHEMA,
+        "targets": numerics,
+        "timestamp": time.time(),
+    }
+    path = atomic_write_text(
+        json.dumps(report, indent=2, sort_keys=True),
+        out_dir / NUMERICS_REPORT_NAME,
+    )
+
+    from dlbb_tpu.bench.schedule import MANIFEST_NAME, MANIFEST_SCHEMA
+
+    manifest_path = out_dir / MANIFEST_NAME
+    manifest: dict[str, Any] = {"schema": MANIFEST_SCHEMA,
+                                "kind": "numerics-audit"}
+    if manifest_path.exists():
+        try:
+            manifest = json.loads(manifest_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            pass  # torn/legacy manifest: rewrite with the audit only
+    manifest["numerics_audit"] = {
+        "targets_audited": len(numerics),
+        "max_rel_error_bound": {
+            t: numerics[t].get("numerics_max_rel_error_bound")
+            for t in sorted(numerics)
+        },
+        "low_precision_sites": {
+            t: numerics[t].get("numerics_low_precision_sites")
+            for t in sorted(numerics)
+        },
+    }
+    manifest.setdefault("timestamp", time.time())
+    save_json(manifest, manifest_path)
+    _fold_metrics(numerics_metrics(numerics), out_dir / METRICS_NAME)
+    return path

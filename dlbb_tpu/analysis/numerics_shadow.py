@@ -1,0 +1,257 @@
+"""fp64 shadow cross-check for the static numerics auditor.
+
+The ``low-precision-accumulation`` rule (``numerics_audit.py``) prices a
+flagged reduction with two analytic worst-case relative-error bounds —
+sequential ``(n-1)·u`` and balanced-tree ``ceil(log2 n)·u``, both
+relative to ``sum(|x_i|)`` (Higham, *Accuracy and Stability of Numerical
+Algorithms*, §4.2, where ``u`` is the accumulator dtype's unit
+roundoff).  A static bound nobody has ever measured against is a claim,
+not a gate — so this module closes the loop empirically:
+
+1. **Static side** — for each shadow case, a seeded HLO module with a
+   genuinely low-precision accumulator (hand-written text: XLA's CPU
+   pipeline auto-upcasts bf16 reduce combiners to f32, so a lowered
+   fixture could not carry the violation) is run through
+   ``analyze_numerics``; the case must be FLAGGED and carry the analytic
+   bounds.
+2. **Empirical side** — the same reduction shape is executed for real at
+   the case's dtype on backend-agnostic jax (a ``lax.scan`` carry for
+   sequential order, a pairwise halving ladder for tree order — carries
+   and explicit adds cannot be silently upcast), against an fp64 shadow
+   reference computed with numpy.  The measured relative error
+   ``|sum_lp - sum_f64| / sum(|x|)`` must land within the analytic bound
+   for the case's summation order.
+3. A case is **confirmed** when static flagging and the measured bound
+   agree (flagged and within bound), **refuted** otherwise.  An f32
+   control case (static: clean; empirical: error orders of magnitude
+   under the bf16 bound) guards against the instrument itself saturating.
+
+The committed report lives at ``stats/analysis/numerics/shadow_report.json``
+and CI re-runs the check via ``scripts/run_static_analysis.sh`` (grep:
+zero refuted, >=1 confirmed).
+
+CLI::
+
+    python -m dlbb_tpu.analysis.numerics_shadow --output stats/analysis/numerics
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+SHADOW_REPORT_SCHEMA = "dlbb_numerics_shadow_v1"
+SHADOW_REPORT_NAME = "shadow_report.json"
+DEFAULT_SHADOW_DIR = Path("stats/analysis/numerics")
+
+# jax dtype name per HLO dtype used by the shadow cases
+_JAX_DTYPES = {"bf16": "bfloat16", "f16": "float16", "f32": "float32"}
+
+
+# ---------------------------------------------------------------------------
+# seeded HLO fixtures (shared with tests/test_numerics_audit.py)
+# ---------------------------------------------------------------------------
+
+
+def seeded_reduction_hlo(n: int, dtype: str = "bf16") -> str:
+    """Minimal post-SPMD-shaped HLO text: a length-``n`` add reduction
+    whose combiner accumulates at ``dtype``.  Hand-written because the
+    CPU XLA pipeline rewrites low-precision reduce combiners to f32 +
+    convert (exactly the upcast the rule exists to verify is absent)."""
+    return f"""\
+HloModule seeded_reduction_{dtype}_{n}, entry_computation_layout={{({dtype}[{n}]{{0}})->{dtype}[]}}
+
+%add_{dtype} (a: {dtype}[], b: {dtype}[]) -> {dtype}[] {{
+  %a = {dtype}[] parameter(0)
+  %b = {dtype}[] parameter(1)
+  ROOT %add = {dtype}[] add({dtype}[] %a, {dtype}[] %b)
+}}
+
+ENTRY %main (x: {dtype}[{n}]) -> {dtype}[] {{
+  %x = {dtype}[{n}]{{0}} parameter(0)
+  %zero = {dtype}[] constant(0)
+  ROOT %reduce = {dtype}[] reduce({dtype}[{n}]{{0}} %x, {dtype}[] %zero), dimensions={{0}}, to_apply=%add_{dtype}
+}}
+"""
+
+
+def _static_audit(n: int, dtype: str) -> tuple[bool, dict]:
+    """Run the seeded module through the real analyzer; returns
+    (flagged, finding details or bound meta)."""
+    from dlbb_tpu.analysis.expectations import TargetExpectation
+    from dlbb_tpu.analysis.hlo_parse import parse_module
+    from dlbb_tpu.analysis.numerics_audit import analyze_numerics
+
+    module = parse_module(seeded_reduction_hlo(n, dtype))
+    findings, meta = analyze_numerics(
+        module, TargetExpectation(), f"shadow::reduce[{dtype},{n}]"
+    )
+    flagged = [f for f in findings
+               if f.rule == "low-precision-accumulation"]
+    details = flagged[0].details if flagged else {
+        "reduction_sites": meta.get("reduction_sites", 0)}
+    return bool(flagged), details
+
+
+# ---------------------------------------------------------------------------
+# empirical low-precision reductions
+# ---------------------------------------------------------------------------
+
+
+def _measured_rel_error(data, dtype: str, order: str) -> float:
+    """Execute the reduction at ``dtype`` in the given summation
+    ``order`` and return ``|sum - shadow_f64_sum| / sum(|x|)``.
+
+    The accumulator genuinely runs at ``dtype``: a ``lax.scan`` carry
+    (sequential) or explicit pairwise adds (tree) — dtype-pinned program
+    points XLA must honour, unlike a ``reduce`` combiner it may upcast."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jdt = jnp.dtype(_JAX_DTYPES[dtype])
+    x = jnp.asarray(data).astype(jdt)
+
+    if order == "sequential":
+        def _sum(v):
+            def body(carry, xi):
+                return carry + xi, None
+            acc, _ = jax.lax.scan(body, jnp.zeros((), jdt), v)
+            return acc
+    elif order == "tree":
+        def _sum(v):
+            while v.shape[0] > 1:
+                v = v[0::2] + v[1::2]
+            return v[0]
+    else:  # pragma: no cover - case-table integrity
+        raise ValueError(f"unknown summation order {order!r}")
+
+    measured = float(np.asarray(jax.jit(_sum)(x), dtype=np.float64))
+    shadow = data.astype(np.float64)
+    ref = float(shadow.sum())
+    denom = float(np.abs(shadow).sum()) or 1.0
+    return abs(measured - ref) / denom
+
+
+# ---------------------------------------------------------------------------
+# the case table
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShadowCase:
+    """One static-flag + empirical-replay pair."""
+
+    name: str
+    dtype: str      # HLO dtype of the accumulator
+    n: int          # reduction length (power of two: the tree ladder halves)
+    order: str      # "sequential" | "tree"
+    expect_flagged: bool = True  # False for the f32 control
+
+
+DEFAULT_CASES: tuple[ShadowCase, ...] = (
+    ShadowCase("bf16-sequential-4096", "bf16", 4096, "sequential"),
+    ShadowCase("bf16-tree-4096", "bf16", 4096, "tree"),
+    ShadowCase("f16-sequential-4096", "f16", 4096, "sequential"),
+    # control: statically clean, and its measured error must sit far
+    # below the bf16 bound or the instrument is saturated
+    ShadowCase("f32-control-4096", "f32", 4096, "sequential",
+               expect_flagged=False),
+)
+
+
+def run_shadow(cases: tuple[ShadowCase, ...] = DEFAULT_CASES,
+               seed: int = 0) -> dict:
+    """Run every case; returns the report dict (see module docstring)."""
+    import numpy as np
+
+    from dlbb_tpu.analysis.numerics_audit import (
+        accumulation_error_bounds,
+        unit_roundoff,
+    )
+
+    rng = np.random.default_rng(seed)
+    rows = []
+    for case in cases:
+        # positive, O(1)-magnitude data: the running partial sums grow to
+        # ~n so low-precision roundoff must actually accrue (a zero-mean
+        # stream would hide sequential error behind cancellation)
+        data = rng.uniform(0.5, 1.5, size=case.n)
+        bound_seq, bound_tree = accumulation_error_bounds(case.n, case.dtype)
+        bound = bound_seq if case.order == "sequential" else bound_tree
+        flagged, details = _static_audit(case.n, case.dtype)
+        measured = _measured_rel_error(data, case.dtype, case.order)
+        if case.expect_flagged:
+            confirmed = flagged and measured <= bound
+        else:
+            # the control must be clean AND resolve errors well under the
+            # low-precision bounds it is controlling for
+            confirmed = (not flagged
+                         and measured <= 8 * case.n * unit_roundoff("f32"))
+        rows.append({
+            "case": case.name,
+            "dtype": case.dtype,
+            "n": case.n,
+            "order": case.order,
+            "static_flagged": flagged,
+            "static_details": details,
+            "predicted_bound_seq": bound_seq,
+            "predicted_bound_tree": bound_tree,
+            "gating_bound": bound,
+            "measured_rel_error": measured,
+            "measured_over_bound": measured / bound if bound else None,
+            "confirmed": confirmed,
+        })
+    confirmed = sum(r["confirmed"] for r in rows)
+    return {
+        "schema": SHADOW_REPORT_SCHEMA,
+        "seed": seed,
+        "unit_roundoff": {d: unit_roundoff(d)
+                          for d in ("f64", "f32", "f16", "bf16")},
+        "cases": rows,
+        "confirmed": confirmed,
+        "refuted": len(rows) - confirmed,
+    }
+
+
+def write_shadow_report(report: dict, out_dir) -> Path:
+    from dlbb_tpu.utils.config import atomic_write_text
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / SHADOW_REPORT_NAME
+    atomic_write_text(json.dumps(report, indent=2, sort_keys=True) + "\n",
+                      path)
+    return path
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--output", default=str(DEFAULT_SHADOW_DIR),
+                    metavar="DIR",
+                    help="directory for the shadow report "
+                         f"(default: {DEFAULT_SHADOW_DIR})")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="rng seed for the shadow payloads")
+    args = ap.parse_args(argv)
+
+    report = run_shadow(seed=args.seed)
+    path = write_shadow_report(report, args.output)
+    for row in report["cases"]:
+        status = "confirmed" if row["confirmed"] else "REFUTED"
+        print(f"[shadow] {row['case']}: {status} — measured rel err "
+              f"{row['measured_rel_error']:.3g} vs bound "
+              f"{row['gating_bound']:.3g} "
+              f"({row['order']}, static_flagged={row['static_flagged']})")
+    print(f"[shadow] {report['confirmed']} confirmed, "
+          f"{report['refuted']} refuted; report at {path}")
+    return 0 if report["refuted"] == 0 and report["confirmed"] else 1
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via scripts/
+    raise SystemExit(main())

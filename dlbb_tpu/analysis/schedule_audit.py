@@ -85,6 +85,13 @@ WIRE_SLACK = 1.10
 # memory axis (the buffer-liveness pass, memory_audit.py): same 10 %
 # contract on peak live bytes and on the largest transient buffer
 PEAK_MEMORY_SLACK = 1.10
+# numerics axis (the dtype-flow pass, numerics_audit.py): the worst-case
+# relative error bound moves only with reduction SHAPE (log2 of the tree
+# fan-in) or accumulator DTYPE (>= 2^13x when f32 drops to bf16), so 2x
+# absorbs shape jitter while any precision downgrade still trips; convert
+# churn gets 25 % headroom on the execution-weighted convert count
+NUMERICS_ERROR_SLACK = 2.0
+NUMERICS_CONVERT_SLACK = 1.25
 
 
 # ---------------------------------------------------------------------------
@@ -564,6 +571,8 @@ _BASELINE_KEYS = (
     "comm_on_critical_path_us", "comm_total_us", "compute_total_us",
     "overlap_efficiency", "total_wire_bytes", "num_collectives",
     "collective_kinds", "peak_live_bytes", "max_transient_bytes",
+    "numerics_low_precision_sites", "numerics_convert_count",
+    "numerics_max_rel_error_bound",
 )
 
 
@@ -698,6 +707,10 @@ def diff_baselines(
              "peak-memory-regression"),
             ("max_transient_bytes", PEAK_MEMORY_SLACK,
              "transient-buffer-regression"),
+            ("numerics_max_rel_error_bound", NUMERICS_ERROR_SLACK,
+             "numerics-error-regression"),
+            ("numerics_convert_count", NUMERICS_CONVERT_SLACK,
+             "convert-churn-regression"),
         ):
             b, c = base.get(key), cur.get(key)
             if not b or c is None:
@@ -730,6 +743,26 @@ def diff_baselines(
                     ),
                     details={"key": key, "baseline": b, "current": c},
                 ))
+        # low-precision accumulation sites gate at exactly zero growth
+        # (the committed fleet is all-f32 today, so the ratio gate above
+        # skips its falsy baseline): any NEW bf16/f16 accumulator is a
+        # deliberate precision decision, like a new collective kind
+        b_sites = base.get("numerics_low_precision_sites")
+        c_sites = cur.get("numerics_low_precision_sites")
+        if (b_sites is not None and c_sites is not None
+                and c_sites > b_sites):
+            findings.append(Finding(
+                pass_name="schedule", rule="new-low-precision-accumulation",
+                severity=SEVERITY_ERROR, target=target,
+                message=(
+                    f"low-precision accumulation sites grew {b_sites} -> "
+                    f"{c_sites} over the committed baseline — a reduction "
+                    "or dot accumulator dropped below f32; confirm the "
+                    "error bound (analyze numerics) and re-snapshot if "
+                    "the downgrade is intended"
+                ),
+                details={"baseline": b_sites, "current": c_sites},
+            ))
     audited = set(schedule_meta) | set(skipped_targets)
     for target in sorted(set(baselines) - audited):
         findings.append(Finding(

@@ -57,6 +57,18 @@ distributed benchmark repo cares about and generic linters do not:
   timed region (``docs/observability.md``); no bracketing exemption.
   The sanctioned API homes (``utils/profiling.py``, ``obs/capture.py``)
   are exempt, like ``utils/timing.py`` is for host syncs.
+- ``float64-literal-in-jit``: a float64 value materialised inside a
+  jitted function (decorated ``@jax.jit`` / ``@partial(jax.jit, ...)``
+  or passed by name to ``jax.jit`` in the same file) or a timed region —
+  ``np.float64(...)``, ``.astype(np.float64 / "float64" / float)``,
+  ``dtype=float64`` keywords, or a dtype-free host-numpy constructor
+  (``np.array`` of float literals, ``np.ones``/``np.zeros``/
+  ``np.linspace``) whose default dtype is float64.  With x64 disabled
+  JAX silently demotes these to f32 (the literal lies about the math
+  that runs); with x64 enabled they double the bytes of everything they
+  touch — wire, HBM, and the number being timed.  The numerics HLO pass
+  (``numerics_audit``) catches f64 that survives to the lowered module;
+  this rule catches it at the source, where the fix belongs.
 - ``non-atomic-artifact-write``: a bare ``json.dump(...)`` (in-place
   write of the destination file) or ``*.write_text(json.dumps(...))``
   outside the sanctioned atomic helper (``utils/config.py``:
@@ -99,6 +111,7 @@ LINT_RULES = (
     "host-transfer-in-loop",
     "unsorted-set-iteration",
     "non-atomic-artifact-write",
+    "float64-literal-in-jit",
 )
 
 # Files whose whole purpose is host synchronisation around measurement.
@@ -692,6 +705,145 @@ def _check_atomic_writes(tree: ast.AST, path: str, findings: list[Finding]):
             ))
 
 
+_JIT_NAMES = ("jax.jit", "jit", "jax.pmap", "pmap")
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted name of an Attribute/Name expression ("" when neither)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)`` (functools
+    spelling included)."""
+    if isinstance(dec, ast.Call):
+        name = _call_name(dec)
+        if name in _JIT_NAMES:
+            return True  # @jax.jit(donate_argnums=...)
+        return (name.rsplit(".", 1)[-1] == "partial" and dec.args
+                and _dotted(dec.args[0]) in _JIT_NAMES)
+    return _dotted(dec) in _JIT_NAMES
+
+
+def _jitted_spans(tree: ast.AST) -> list[tuple[int, int]]:
+    """Line spans of every function the file jits: decorated defs plus
+    defs whose NAME is passed to ``jax.jit``/``pmap`` anywhere in the
+    file (the ``step_fn = jax.jit(step_fn, ...)`` idiom)."""
+    defs: dict[str, ast.AST] = {}
+    spans: list[tuple[int, int]] = []
+    jit_arg_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                spans.append((node.lineno, node.end_lineno or node.lineno))
+        elif (isinstance(node, ast.Call) and _call_name(node) in _JIT_NAMES
+                and node.args and isinstance(node.args[0], ast.Name)):
+            jit_arg_names.add(node.args[0].id)
+    for name in sorted(jit_arg_names):
+        d = defs.get(name)
+        if d is not None:
+            spans.append((d.lineno, d.end_lineno or d.lineno))
+    return spans
+
+
+def _f64_dtype_desc(e: ast.AST) -> Optional[str]:
+    """Description when ``e`` denotes the float64 dtype: the
+    ``np.float64``/``jnp.float64`` attribute, the ``"float64"``/
+    ``"double"`` string, or the Python ``float`` builtin (float64 by
+    definition)."""
+    name = _dotted(e)
+    if name and name.rsplit(".", 1)[-1] in ("float64", "double"):
+        return name
+    if isinstance(e, ast.Constant) and e.value in ("float64", "double"):
+        return repr(e.value)
+    if isinstance(e, ast.Name) and e.id == "float":
+        return "float (the Python builtin is float64)"
+    return None
+
+
+# dtype-free host-numpy constructors whose default result dtype is
+# float64 regardless of argument dtypes
+_NP_F64_DEFAULT_CTORS = {"ones", "zeros", "linspace", "full"}
+
+
+def _float64_sites(tree: ast.AST) -> Iterable[tuple[ast.AST, str]]:
+    """(node, description) for every expression that materialises a
+    float64 value: ``np.float64(x)`` casts, ``.astype`` upcasts,
+    ``dtype=float64`` keywords, and dtype-free host-numpy constructors
+    (``np.array`` of float literals; ``np.ones``/``zeros``/``linspace``/
+    ``full`` always)."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node)
+        short = name.rsplit(".", 1)[-1]
+        if short in ("float64", "double") and "." in name:
+            yield node, f"{name}(...) cast"
+            continue
+        if short == "astype" and node.args:
+            desc = _f64_dtype_desc(node.args[0])
+            if desc:
+                yield node, f".astype({desc})"
+                continue
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                desc = _f64_dtype_desc(kw.value)
+                if desc:
+                    yield node, f"{name}(dtype={desc})"
+                break
+        else:
+            if name.split(".")[0] not in ("np", "numpy"):
+                continue
+            if short in _NP_F64_DEFAULT_CTORS:
+                yield node, (f"{name}(...) without dtype= "
+                             "(host numpy defaults to float64)")
+            elif short in ("array", "asarray") and node.args and any(
+                    isinstance(c, ast.Constant) and isinstance(c.value, float)
+                    for c in ast.walk(node.args[0])):
+                yield node, (f"{name}(...) of float literals without "
+                             "dtype= (host numpy defaults to float64)")
+
+
+def _check_float64(tree: ast.AST, path: str, findings: list[Finding],
+                   include_timed: bool = True):
+    """``float64-literal-in-jit``: float64 materialised inside a jitted
+    function or a timed region.  With jax x64 disabled the value is
+    silently demoted to f32 (the source lies about the math that runs);
+    with x64 enabled it doubles the bytes of everything downstream."""
+    spans = _jitted_spans(tree)
+    if include_timed:
+        spans += _timed_line_spans(tree)
+    if not spans:
+        return
+    for node, desc in _float64_sites(tree):
+        line = node.lineno
+        if not any(lo <= line <= hi for lo, hi in spans):
+            continue
+        findings.append(Finding(
+            pass_name="lint",
+            rule="float64-literal-in-jit",
+            severity=SEVERITY_ERROR,
+            target=path,
+            message=(
+                f"{desc} inside a jitted function or timed region "
+                "materialises float64 — silently demoted to f32 when "
+                "jax x64 is off (the literal lies about the math that "
+                "runs), and doubled wire/HBM bytes when it is on; pin "
+                "an explicit 32-bit dtype (jnp.float32 / the model's "
+                "policy dtype)"
+            ),
+            location=f"{path}:{line}",
+            details={"expression": desc},
+        ))
+
+
 def _check_set_iteration(tree: ast.AST, path: str, findings: list[Finding]):
     def is_set_expr(e: ast.AST) -> bool:
         if isinstance(e, ast.Set):
@@ -752,6 +904,10 @@ def lint_source(source: str, path: str) -> tuple[list[Finding], int]:
     _check_donation(tree, path, findings)
     _check_jit_in_loop(tree, path, findings)
     _check_set_iteration(tree, path, findings)
+    # the timing API computes host-side stats inside its own perf_counter
+    # spans by design — its timed regions are exempt (jitted fns are not)
+    _check_float64(tree, path, findings,
+                   include_timed=not norm.endswith(TIMING_API_FILES))
     if not norm.endswith(ATOMIC_API_FILES):
         _check_atomic_writes(tree, path, findings)
 

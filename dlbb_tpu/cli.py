@@ -156,16 +156,17 @@ def build_parser() -> argparse.ArgumentParser:
              "1 findings / 2 crash (docs/schedule_audit.md)",
     )
     an.add_argument("which", nargs="?", default="all",
-                    choices=("hlo", "lint", "schedule", "memory", "all",
-                             "snapshot", "diff"),
+                    choices=("hlo", "lint", "schedule", "memory",
+                             "numerics", "all", "snapshot", "diff"),
                     help="pass to run: hlo = collective byte audit, "
                          "schedule = α–β critical-path/overlap audit, "
                          "memory = buffer-liveness peak-HBM audit, "
+                         "numerics = dtype-flow precision audit, "
                          "lint = AST source lint, all = every pass "
                          "(default); snapshot = (re)write the "
-                         "regression baselines (schedule + memory "
-                         "axes), diff = fail on unexplained drift from "
-                         "the committed baselines")
+                         "regression baselines (schedule + memory + "
+                         "numerics axes), diff = fail on unexplained "
+                         "drift from the committed baselines")
     an.add_argument("--simulate", type=int, default=0, metavar="N",
                     help="use an N-device CPU-simulated mesh for the HLO "
                          "audit (targets needing more devices than "
@@ -191,12 +192,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "(stats/analysis/costmodel_fit/; falls back to "
                          "cm1 with a fit-missing warning)")
     an.add_argument("--output", default=None, metavar="DIR",
-                    help="observability surface for the memory audit: "
-                         "write memory_audit.json under DIR, merge the "
-                         "per-target peak_live_bytes (+ audit tier) "
-                         "into DIR/sweep_manifest.json, and fold "
-                         "analysis_peak_live_bytes{target} gauges into "
-                         "DIR/metrics.prom (docs/memory_audit.md)")
+                    help="observability surface for the memory + "
+                         "numerics audits: write memory_audit.json / "
+                         "numerics_audit.json under DIR, merge the "
+                         "per-target peak_live_bytes and numerics gate "
+                         "keys into DIR/sweep_manifest.json, and fold "
+                         "analysis_peak_live_bytes{target} / "
+                         "analysis_numerics_* / per-pass "
+                         "analysis_findings{pass,severity} gauges into "
+                         "DIR/metrics.prom (docs/memory_audit.md, "
+                         "docs/numerics.md)")
 
     ob = sub.add_parser(
         "obs",

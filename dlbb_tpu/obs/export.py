@@ -304,6 +304,42 @@ def serving_metrics(report: dict[str, Any],
     return registry
 
 
+ANALYSIS_PASSES = ("hlo", "lint", "schedule", "memory", "numerics")
+
+
+def analysis_metrics(report: Any,
+                     registry: Optional[MetricsRegistry] = None
+                     ) -> MetricsRegistry:
+    """Fold a comm-lint :class:`~dlbb_tpu.analysis.findings.AnalysisReport`
+    into per-pass finding-count gauges — the static-verification analogue
+    of :func:`sweep_metrics`, folded into ``metrics.prom`` by ``analyze
+    --output`` so suppression/violation drift is observable across PRs.
+
+    Every known pass gets a sample at both severities even when clean
+    (zeros are the signal: a pass that stops reporting is a silently
+    dropped gate, which a dashboard can only see if the series exists)."""
+    registry = registry or MetricsRegistry()
+    counts: dict[tuple[str, str], int] = {
+        (p, sev): 0
+        for p in ANALYSIS_PASSES
+        for sev in ("error", "warning")
+    }
+    for f in getattr(report, "findings", ()):
+        key = (f.pass_name, f.severity)
+        counts[key] = counts.get(key, 0) + 1
+    for (pass_name, severity), n in sorted(counts.items()):
+        registry.set_gauge(
+            "analysis_findings", n,
+            help="comm-lint findings by static pass and severity",
+            severity=severity, **{"pass": pass_name},
+        )
+    registry.set_gauge(
+        "analysis_suppressed", getattr(report, "suppressed", 0),
+        help="comm-lint findings silenced by inline suppressions",
+    )
+    return registry
+
+
 def sweep_metrics(manifest: dict[str, Any],
                   registry: Optional[MetricsRegistry] = None
                   ) -> MetricsRegistry:

@@ -55,6 +55,43 @@ grep -q '"memory_audit"' "$MEM_TMP/sweep_manifest.json" \
          exit 1; }
 rm -rf "$MEM_TMP"
 
+# numerics_smoke (docs/numerics.md): the dtype-flow numerics audit runs
+# INSIDE `analyze all` above (low-precision accumulators priced with
+# Higham sequential/tree error bounds, silent upcasts against the
+# declared policy dtype, quantise round trips without intervening
+# arithmetic, convert churn across fusion boundaries, bitwise-
+# reproducibility claims vs multi-replica reduction order), and
+# `analyze diff` above regression-gates the committed numerics axis
+# (>2x error-bound growth, >1.25x convert churn, or ANY new
+# low-precision accumulation site fails).  The pytest marker pins the
+# seeded-violation fixtures tripping every rule, real targets staying
+# clean, and the fp64 shadow cross-check; the CLI run below exercises
+# the observability surface — numerics_audit.json + manifest merge +
+# analysis_numerics_* and per-pass analysis_findings gauges — over the
+# default registry (the pass fails closed on an empty target surface),
+# clean with zero suppressions.  The standalone shadow run then
+# re-confirms the analytic bounds empirically against fp64 references.
+JAX_PLATFORMS=cpu python -m pytest tests/test_numerics_audit.py -q \
+    -m numerics_smoke -p no:cacheprovider
+NUM_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli analyze numerics --simulate 8 \
+    --strict-warnings --output "$NUM_TMP"
+grep -q 'dlbb_analysis_numerics_max_rel_error_bound' "$NUM_TMP/metrics.prom" \
+    || { echo "numerics_smoke: metrics.prom lost the error-bound gauges"; \
+         exit 1; }
+grep -q 'dlbb_analysis_findings{' "$NUM_TMP/metrics.prom" \
+    || { echo "numerics_smoke: metrics.prom lost the per-pass finding gauges"; \
+         exit 1; }
+grep -q '"numerics_audit"' "$NUM_TMP/sweep_manifest.json" \
+    || { echo "numerics_smoke: manifest lost the numerics-audit record"; \
+         exit 1; }
+JAX_PLATFORMS=cpu python -m dlbb_tpu.analysis.numerics_shadow \
+    --output "$NUM_TMP/shadow"
+grep -q '"refuted": 0' "$NUM_TMP/shadow/shadow_report.json" \
+    || { echo "numerics_smoke: shadow cross-check refuted a static bound"; \
+         exit 1; }
+rm -rf "$NUM_TMP"
+
 # obs_smoke (docs/observability.md): a span-traced + device-captured
 # mini-sweep must publish stats equivalent to an untraced serial run
 # (dedicated profile reps never enter the stats series; the span trace

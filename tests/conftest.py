@@ -128,6 +128,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "numerics_smoke: static numerics-audit smoke — seeded "
+        "low-precision/upcast/roundtrip HLO fixtures trip every rule, "
+        "real targets stay clean, and the fp64 shadow cross-check "
+        "confirms the analytic error bound empirically (tier-1; also "
+        "invoked standalone by scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )

@@ -1,0 +1,703 @@
+"""Static numerics auditor: seeded-violation fixtures for every rule,
+fusion-coverage regression on a captured fused-scan HLO, clean real
+targets, the baseline/diff gate on the numerics axis, the fp64 shadow
+cross-check, and the ``float64-literal-in-jit`` source-lint rule.
+
+Seeded fixtures are hand-written HLO text: the CPU XLA pipeline folds
+identity converts and auto-upcasts bf16 reduce combiners to f32 — i.e.
+it OPTIMISES AWAY the violations the pass exists to catch — so a lowered
+fixture cannot carry them (the same reason the shadow cross-check forces
+its low-precision accumulators through scan carries).
+
+The ``numerics_smoke`` marker subset is also invoked standalone by
+``scripts/run_static_analysis.sh``.
+"""
+
+import gzip
+import json
+from pathlib import Path
+
+import pytest
+
+from dlbb_tpu.analysis.expectations import (
+    TargetExpectation,
+    policy_dtype_for,
+)
+from dlbb_tpu.analysis.hlo_parse import parse_module, resolve_producers
+from dlbb_tpu.analysis.numerics_audit import (
+    LOW_PRECISION_ACCUM_FLOOR,
+    accumulation_error_bounds,
+    analyze_numerics,
+    numerics_metrics,
+    unit_roundoff,
+    write_numerics_artifacts,
+)
+from dlbb_tpu.analysis.numerics_shadow import (
+    ShadowCase,
+    run_shadow,
+    seeded_reduction_hlo,
+    write_shadow_report,
+)
+
+FIXTURE_DIR = Path(__file__).parent / "data"
+FUSED_SCAN_FIXTURE = FIXTURE_DIR / "decode_fused_k4_dp_tp.hlo.txt.gz"
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# error-bound model
+# ---------------------------------------------------------------------------
+
+
+def test_unit_roundoff_table():
+    assert unit_roundoff("f32") == 2.0 ** -24
+    assert unit_roundoff("bf16") == 2.0 ** -8
+    assert unit_roundoff("f16") == 2.0 ** -11
+    assert unit_roundoff("f64") == 2.0 ** -53
+    assert unit_roundoff("s8") is None
+
+
+def test_accumulation_error_bounds():
+    seq, tree = accumulation_error_bounds(4096, "bf16")
+    assert seq == 4095 * 2.0 ** -8
+    assert tree == 12 * 2.0 ** -8  # ceil(log2 4096) = 12
+    assert accumulation_error_bounds(1, "bf16") == (0.0, 0.0)
+    # a bf16 accumulator over 4k elements is total loss; f32 is not
+    assert seq > 1.0
+    assert accumulation_error_bounds(4096, "f32")[0] < 2.5e-4
+
+
+# ---------------------------------------------------------------------------
+# seeded-violation fixtures (hand-written HLO, one per rule)
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_low_precision_accumulation():
+    findings, meta = analyze_numerics(
+        seeded_reduction_hlo(4096, "bf16"), TargetExpectation(),
+        "seed::bf16-reduce")
+    assert _rules(findings) == ["low-precision-accumulation"]
+    d = findings[0].details
+    assert d["elements"] == 4096
+    assert d["bound_sequential"] == 4095 * 2.0 ** -8
+    assert meta["numerics_low_precision_sites"] == 1
+    assert meta["numerics_max_rel_error_bound"] == d["bound_tree"]
+    # the same shape accumulated in f32 is clean
+    clean, _ = analyze_numerics(
+        seeded_reduction_hlo(4096, "f32"), TargetExpectation(),
+        "seed::f32-reduce")
+    assert clean == []
+
+
+SEEDED_UPCAST = """\
+HloModule seeded_upcast, entry_computation_layout={(f32[4096]{0})->f32[4096]{0}}
+
+%add_f32 (a: f32[], b: f32[]) -> f32[] {
+  %a = f32[] parameter(0)
+  %b = f32[] parameter(1)
+  ROOT %add = f32[] add(f32[] %a, f32[] %b)
+}
+
+ENTRY %main (x: f32[4096]) -> f32[4096] {
+  %x = f32[4096]{0} parameter(0)
+  ROOT %ar = f32[4096]{0} all-reduce(f32[4096]{0} %x), channel_id=1, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, to_apply=%add_f32
+}
+"""
+
+SEEDED_WHILE_UPCAST = """\
+HloModule seeded_while_upcast, entry_computation_layout={(f32[2048]{0})->(s32[], f32[2048]{0})}
+
+%body (p: (s32[], f32[2048])) -> (s32[], f32[2048]) {
+  %p = (s32[], f32[2048]{0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[2048]{0}) %p), index=0
+  %one = s32[] constant(1)
+  %ip = s32[] add(s32[] %i, s32[] %one)
+  %v = f32[2048]{0} get-tuple-element((s32[], f32[2048]{0}) %p), index=1
+  ROOT %t = (s32[], f32[2048]{0}) tuple(s32[] %ip, f32[2048]{0} %v)
+}
+
+%cond (p: (s32[], f32[2048])) -> pred[] {
+  %p = (s32[], f32[2048]{0}) parameter(0)
+  %i = s32[] get-tuple-element((s32[], f32[2048]{0}) %p), index=0
+  %n = s32[] constant(8)
+  ROOT %lt = pred[] compare(s32[] %i, s32[] %n), direction=LT
+}
+
+ENTRY %main (x: f32[2048]) -> (s32[], f32[2048]) {
+  %x = f32[2048]{0} parameter(0)
+  %zero = s32[] constant(0)
+  %init = (s32[], f32[2048]{0}) tuple(s32[] %zero, f32[2048]{0} %x)
+  ROOT %loop = (s32[], f32[2048]{0}) while((s32[], f32[2048]{0}) %init), condition=%cond, body=%body
+}
+"""
+
+
+def test_seeded_silent_upcast_collective():
+    findings, _ = analyze_numerics(
+        SEEDED_UPCAST, TargetExpectation(policy_dtype="bf16"),
+        "seed::upcast", num_devices=8)
+    assert _rules(findings) == ["silent-upcast"]
+    # half the f32 payload is bytes the bf16 plan never priced
+    assert findings[0].details["extra_bytes"] == 4096 * 4 // 2
+    # without a declared low policy the same module is legal f32 math
+    clean, _ = analyze_numerics(
+        SEEDED_UPCAST, TargetExpectation(policy_dtype="f32"),
+        "seed::upcast-f32", num_devices=8)
+    assert clean == []
+
+
+def test_seeded_silent_upcast_while_carry():
+    findings, _ = analyze_numerics(
+        SEEDED_WHILE_UPCAST, TargetExpectation(policy_dtype="bf16"),
+        "seed::while-upcast", peak_live_bytes=32_768)
+    rules = _rules(findings)
+    assert "silent-upcast" in rules
+    carry = [f for f in findings if "while-carry" in f.message][0]
+    assert carry.details["extra_bytes"] == 2048 * 4 // 2
+    assert carry.details["peak_live_bytes"] == 32_768
+
+
+SEEDED_ROUNDTRIP = """\
+HloModule seeded_roundtrip, entry_computation_layout={(s8[1024]{0})->s8[1024]{0}}
+
+ENTRY %main (x: s8[1024]) -> s8[1024] {
+  %x = s8[1024]{0} parameter(0)
+  %dq = f32[1024]{0} convert(s8[1024]{0} %x)
+  %scale = f32[] constant(0.5)
+  %bscale = f32[1024]{0} broadcast(f32[] %scale), dimensions={}
+  %scaled = f32[1024]{0} multiply(f32[1024]{0} %dq, f32[1024]{0} %bscale)
+  ROOT %q = s8[1024]{0} convert(f32[1024]{0} %scaled)
+}
+"""
+
+# the legitimate ring hop: dequantise -> ACCUMULATE (equal-size add)
+# -> requantise.  The add aborts the trace, so no finding.
+SEEDED_RING_HOP = """\
+HloModule seeded_ring_hop, entry_computation_layout={(s8[1024]{0}, f32[1024]{0})->s8[1024]{0}}
+
+ENTRY %main (x: s8[1024], acc: f32[1024]) -> s8[1024] {
+  %x = s8[1024]{0} parameter(0)
+  %acc = f32[1024]{0} parameter(1)
+  %dq = f32[1024]{0} convert(s8[1024]{0} %x)
+  %sum = f32[1024]{0} add(f32[1024]{0} %dq, f32[1024]{0} %acc)
+  ROOT %q = s8[1024]{0} convert(f32[1024]{0} %sum)
+}
+"""
+
+
+def test_seeded_quantise_roundtrip():
+    findings, _ = analyze_numerics(
+        SEEDED_ROUNDTRIP, TargetExpectation(), "seed::roundtrip")
+    assert _rules(findings) == ["quantise-roundtrip"]
+    assert findings[0].details["wire_dtype"] == "s8"
+
+
+def test_ring_hop_requantise_is_legitimate():
+    findings, _ = analyze_numerics(
+        SEEDED_RING_HOP, TargetExpectation(), "seed::ring-hop")
+    assert findings == []
+
+
+SEEDED_CHURN = """\
+HloModule seeded_churn, entry_computation_layout={(bf16[256]{0})->bf16[256]{0}}
+
+%fused_up (p0: bf16[256]) -> f32[256] {
+  %p0 = bf16[256]{0} parameter(0)
+  ROOT %up = f32[256]{0} convert(bf16[256]{0} %p0)
+}
+
+ENTRY %main (x: bf16[256]) -> bf16[256] {
+  %x = bf16[256]{0} parameter(0)
+  %fus = f32[256]{0} fusion(bf16[256]{0} %x), kind=kLoop, calls=%fused_up
+  %idn = bf16[256]{0} convert(bf16[256]{0} %x)
+  ROOT %down = bf16[256]{0} convert(f32[256]{0} %fus)
+}
+"""
+
+# the intentional precision clamp (f32 -> bf16 -> f32, NARROWING middle)
+# must never be churn: allreduce_q's fusions clamp exactly like this
+SEEDED_CLAMP = """\
+HloModule seeded_clamp, entry_computation_layout={(f32[256]{0})->f32[256]{0}}
+
+ENTRY %main (x: f32[256]) -> f32[256] {
+  %x = f32[256]{0} parameter(0)
+  %down = bf16[256]{0} convert(f32[256]{0} %x)
+  ROOT %up = f32[256]{0} convert(bf16[256]{0} %down)
+}
+"""
+
+
+def test_seeded_convert_churn_crosses_fusion_boundary():
+    """The widening-roundtrip leg only fires if resolve_producers can
+    descend into the fusion body where the inner convert lives — the
+    satellite-2 fusion-coverage regression, pinned on a seeded module."""
+    findings, meta = analyze_numerics(
+        SEEDED_CHURN, TargetExpectation(), "seed::churn")
+    assert sorted(_rules(findings)) == ["convert-churn", "convert-churn"]
+    widening = [f for f in findings if "chain" in f.details
+                and len(f.details["chain"]) == 3][0]
+    assert widening.details["chain"] == ["bf16", "f32", "bf16"]
+    assert "fused_up" in widening.details["intermediate"]
+    assert meta["numerics_convert_count"] >= 3
+
+
+def test_narrowing_clamp_is_not_churn():
+    findings, _ = analyze_numerics(
+        SEEDED_CLAMP, TargetExpectation(), "seed::clamp")
+    assert findings == []
+
+
+def test_seeded_nondeterministic_reduction():
+    # counted in meta always; a finding only under the bitwise claim
+    findings, meta = analyze_numerics(
+        SEEDED_UPCAST, TargetExpectation(), "seed::nondet")
+    assert findings == []
+    assert meta["nondeterministic_reductions"] == 1
+    findings, _ = analyze_numerics(
+        SEEDED_UPCAST,
+        TargetExpectation(expect_bitwise_reproducible=True),
+        "seed::nondet-claimed")
+    assert _rules(findings) == ["nondeterministic-reduction"]
+    assert findings[0].details["group_size"] == 8
+
+
+SEEDED_F64 = """\
+HloModule seeded_f64, entry_computation_layout={(f64[512]{0})->f64[512]{0}}
+
+ENTRY %main (x: f64[512]) -> f64[512] {
+  %x = f64[512]{0} parameter(0)
+  ROOT %y = f64[512]{0} add(f64[512]{0} %x, f64[512]{0} %x)
+}
+"""
+
+SEEDED_BELOW_POLICY = """\
+HloModule seeded_below_policy, entry_computation_layout={(bf16[1024]{0})->bf16[]}
+
+%add_bf16 (a: bf16[], b: bf16[]) -> bf16[] {
+  %a = bf16[] parameter(0)
+  %b = bf16[] parameter(1)
+  ROOT %add = bf16[] add(bf16[] %a, bf16[] %b)
+}
+
+ENTRY %main (x: bf16[1024]) -> bf16[] {
+  %x = bf16[1024]{0} parameter(0)
+  %zero = bf16[] constant(0)
+  %win = bf16[64]{0} slice(bf16[1024]{0} %x), slice={[0:64]}
+  ROOT %reduce = bf16[] reduce(bf16[64]{0} %win, bf16[] %zero), dimensions={0}, to_apply=%add_bf16
+}
+"""
+
+
+def test_seeded_policy_conformance():
+    findings, _ = analyze_numerics(
+        SEEDED_F64, TargetExpectation(policy_dtype="f32"), "seed::f64")
+    assert _rules(findings) == ["policy-conformance"]
+    assert "f64" in findings[0].message
+
+    findings, _ = analyze_numerics(
+        SEEDED_BELOW_POLICY, TargetExpectation(policy_dtype="f32"),
+        "seed::below-policy")
+    assert _rules(findings) == ["policy-conformance", "policy-conformance"]
+    msgs = " ".join(f.message for f in findings)
+    assert "parameter" in msgs and "accumulator" in msgs
+    # under a matching bf16 policy the same module is conformant (the
+    # short n=64 reduction sits under the accumulation floor too)
+    assert 64 < LOW_PRECISION_ACCUM_FLOOR
+    clean, _ = analyze_numerics(
+        SEEDED_BELOW_POLICY, TargetExpectation(policy_dtype="bf16"),
+        "seed::bf16-ok")
+    assert clean == []
+
+
+def test_policy_dtype_for():
+    assert policy_dtype_for("float32") == "f32"
+    assert policy_dtype_for("bfloat16") == "bf16"
+    assert policy_dtype_for("float16") == "f16"
+    with pytest.raises(ValueError):
+        policy_dtype_for("float8_e4m3")
+
+
+# ---------------------------------------------------------------------------
+# fusion-computation coverage (satellite 2) on the captured fused scan
+# ---------------------------------------------------------------------------
+
+
+def test_fused_scan_fixture_fusion_bodies_are_visited():
+    """Regression on a captured decode_fused compile: graph walks must
+    see instructions inside fusion bodies (where the dot accumulators
+    actually live), and producer resolution must cross the boundary."""
+    module = parse_module(
+        gzip.open(FUSED_SCAN_FIXTURE, "rt").read())
+    entry = module.entry_computation()
+    assert entry is not None
+    fusion_comps = {
+        callee for _c, i in module.all_instructions()
+        for role, callee in i.called if role == "calls"
+    }
+    assert fusion_comps, "captured module must contain fusions"
+    visited = {c.name for c, _i in module.all_instructions()}
+    assert fusion_comps <= visited, (
+        "all_instructions() skipped fusion bodies: "
+        f"{sorted(fusion_comps - visited)[:5]}")
+    # at least one fusion body does real arithmetic the walk can reach
+    fused_arith = [
+        (c, i) for c, i in module.all_instructions()
+        if c.name in fusion_comps and i.opcode in ("add", "multiply",
+                                                   "convert", "dot")
+    ]
+    assert fused_arith
+    # producer resolution crosses a fusion call site: resolving a fusion
+    # result must land on the body root, not dead-end at the call
+    for comp, instr in module.all_instructions():
+        if instr.opcode == "fusion" and comp.name == entry.name:
+            producers = resolve_producers(module, comp, instr.name)
+            assert any(c.name in fusion_comps for c, _p in producers), (
+                f"%{instr.name} did not resolve into its body")
+            break
+    else:
+        pytest.fail("no fusion instruction in the entry computation")
+
+
+def test_fused_scan_fixture_numerics_meta():
+    """The captured serving fast path: f32 policy-clean, with its dot
+    reduction sites (inside the scan body) visible to the audit."""
+    module = parse_module(gzip.open(FUSED_SCAN_FIXTURE, "rt").read())
+    findings, meta = analyze_numerics(
+        module, TargetExpectation(policy_dtype="f32"),
+        "fixture::decode_fused", num_devices=8)
+    assert findings == [], [f.render() for f in findings]
+    assert meta["reduction_sites"] > 0
+    assert meta["numerics_low_precision_sites"] == 0
+    assert 0 < meta["numerics_max_rel_error_bound"] < 1e-5  # f32 bounds
+
+
+# ---------------------------------------------------------------------------
+# real targets stay clean (the smoke subset; the full 39-target surface
+# is gated by `cli analyze numerics` in scripts/run_static_analysis.sh)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.numerics_smoke
+def test_real_targets_audit_clean(devices):
+    from dlbb_tpu.analysis.hlo_audit import audit_target, default_targets
+
+    want = {
+        "comm/ops.py::allreduce_q[int8]",
+        "train/loop.py::train_step[ddp,compressed=int8]",
+        "serve/engine.py::decode_fused[k4,dp,tp]",
+    }
+    targets = [t for t in default_targets() if t.name in want]
+    assert len(targets) == len(want)
+    for target in targets:
+        findings, meta = audit_target(target, passes=("numerics",))
+        assert findings == [], [f.render() for f in findings]
+        num = meta["numerics"]
+        assert num["numerics_low_precision_sites"] == 0
+        # every fp dtype present is declared-policy or a wire format
+        assert "f64" not in num["fp_dtypes"]
+
+
+@pytest.mark.numerics_smoke
+def test_seeded_fixture_drives_audit_to_findings(devices):
+    """End-to-end: a target whose lowering carries a bf16 long reduction
+    must exit with findings through the full audit_target path."""
+    from dlbb_tpu.analysis.hlo_audit import AuditTarget, audit_target
+
+    class _PreLowered:
+        """Stand-in jit object returning fixed HLO text."""
+
+        def __init__(self, text):
+            self._text = text
+
+        def lower(self, *args):
+            return self
+
+        def compile(self):
+            return self
+
+        def as_text(self):
+            return self._text
+
+    seeded = AuditTarget(
+        name="seeded::bf16-reduction",
+        build=lambda: (_PreLowered(seeded_reduction_hlo(2048, "bf16")), ()),
+        expectation=TargetExpectation(policy_dtype="bf16"),
+        min_devices=1,
+    )
+    findings, meta = audit_target(seeded, passes=("numerics",))
+    assert "low-precision-accumulation" in _rules(findings)
+    assert meta["numerics"]["numerics_low_precision_sites"] == 1
+
+
+# ---------------------------------------------------------------------------
+# baseline snapshot / diff gate on the numerics axis
+# ---------------------------------------------------------------------------
+
+
+_BASE = {
+    "cost_model_version": "cm1", "tier": "cpu-sim",
+    "critical_path_us": 10.0, "comm_on_critical_path_us": 5.0,
+    "comm_total_us": 6.0, "compute_total_us": 2.0,
+    "overlap_efficiency": 0.5, "total_wire_bytes": 4096,
+    "num_collectives": 4, "collective_kinds": {"all-reduce": 4},
+    "peak_live_bytes": 100_000, "max_transient_bytes": 10_000,
+    "numerics_low_precision_sites": 0, "numerics_convert_count": 40,
+    "numerics_max_rel_error_bound": 4.0e-7,
+}
+
+
+def test_diff_fails_on_numerics_axis_alone(tmp_path):
+    from dlbb_tpu.analysis.schedule_audit import (
+        diff_baselines,
+        snapshot_baselines,
+    )
+
+    snapshot_baselines({"t": _BASE}, tmp_path)
+    ok = diff_baselines({"t": dict(_BASE)}, tmp_path)
+    assert [f for f in ok if f.severity == "error"] == []
+
+    # error bound drift beyond the 2x slack (e.g. an f32 -> f16 accum
+    # downgrade moves it ~2^13x; shape jitter stays under 2x)
+    drifted = dict(_BASE, numerics_max_rel_error_bound=1.0e-6)
+    errors = [f.rule for f in diff_baselines({"t": drifted}, tmp_path)
+              if f.severity == "error"]
+    assert errors == ["numerics-error-regression"]
+
+    churned = dict(_BASE, numerics_convert_count=60)
+    errors = [f.rule for f in diff_baselines({"t": churned}, tmp_path)
+              if f.severity == "error"]
+    assert errors == ["convert-churn-regression"]
+
+    # the zero-baseline axis gates at exactly zero growth — the ratio
+    # gate would skip a falsy baseline, so this needs its own rule
+    downgraded = dict(_BASE, numerics_low_precision_sites=1)
+    errors = [f.rule for f in diff_baselines({"t": downgraded}, tmp_path)
+              if f.severity == "error"]
+    assert errors == ["new-low-precision-accumulation"]
+
+
+def test_committed_baselines_carry_numerics_axis():
+    from dlbb_tpu.analysis.schedule_audit import (
+        DEFAULT_BASELINE_DIR,
+        load_baselines,
+    )
+
+    baselines = load_baselines(DEFAULT_BASELINE_DIR)
+    assert len(baselines) >= 30
+    for name, base in baselines.items():
+        assert base.get("numerics_low_precision_sites") == 0, name
+        assert "numerics_convert_count" in base, name
+        assert "numerics_max_rel_error_bound" in base, name
+
+
+# ---------------------------------------------------------------------------
+# fp64 shadow cross-check
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.numerics_smoke
+def test_shadow_confirms_static_bounds(tmp_path, devices):
+    cases = (
+        ShadowCase("bf16-sequential-2048", "bf16", 2048, "sequential"),
+        ShadowCase("bf16-tree-2048", "bf16", 2048, "tree"),
+        ShadowCase("f32-control-2048", "f32", 2048, "sequential",
+                   expect_flagged=False),
+    )
+    report = run_shadow(cases, seed=7)
+    assert report["refuted"] == 0
+    assert report["confirmed"] == len(cases)
+    by_name = {r["case"]: r for r in report["cases"]}
+    flagged = by_name["bf16-sequential-2048"]
+    assert flagged["static_flagged"] is True
+    assert 0 < flagged["measured_rel_error"] <= flagged["gating_bound"]
+    control = by_name["f32-control-2048"]
+    assert control["static_flagged"] is False
+    # the control's error is orders of magnitude under the bf16 bound
+    assert control["measured_rel_error"] < flagged["gating_bound"] * 1e-3
+
+    path = write_shadow_report(report, tmp_path)
+    data = json.loads(path.read_text())
+    assert data["schema"] == "dlbb_numerics_shadow_v1"
+    assert data["confirmed"] == len(cases)
+
+
+def test_committed_shadow_report():
+    """The committed cross-check artifact: zero refuted, at least one
+    statically flagged accumulation site confirmed within its bound."""
+    path = Path("stats/analysis/numerics/shadow_report.json")
+    data = json.loads(path.read_text())
+    assert data["schema"] == "dlbb_numerics_shadow_v1"
+    assert data["refuted"] == 0
+    confirmed_flagged = [
+        r for r in data["cases"]
+        if r["confirmed"] and r["static_flagged"]
+        and r["measured_rel_error"] <= r["gating_bound"]
+    ]
+    assert confirmed_flagged, "no flagged site confirmed within bound"
+
+
+# ---------------------------------------------------------------------------
+# observability surface
+# ---------------------------------------------------------------------------
+
+
+def test_numerics_metrics_and_artifacts(tmp_path):
+    numerics = {
+        "comm/ops.py::allreduce": {
+            "numerics_max_rel_error_bound": 0.0,
+            "numerics_low_precision_sites": 0,
+            "numerics_convert_count": 0},
+        "serve/engine.py::decode_fused[k4,dp,tp]": {
+            "numerics_max_rel_error_bound": 3.58e-7,
+            "numerics_low_precision_sites": 0,
+            "numerics_convert_count": 6},
+    }
+    text = numerics_metrics(numerics).to_prometheus()
+    assert ('dlbb_analysis_numerics_convert_count{target="serve/'
+            'engine.py::decode_fused[k4,dp,tp]"} 6') in text
+    assert "dlbb_analysis_numerics_targets 2" in text
+
+    (tmp_path / "metrics.prom").write_text(
+        "# TYPE dlbb_sweep_wall_seconds gauge\n"
+        "dlbb_sweep_wall_seconds 1.5\n")
+    (tmp_path / "sweep_manifest.json").write_text(
+        json.dumps({"schema": "dlbb_sweep_manifest_v1", "kind": "1d"}))
+    write_numerics_artifacts(numerics, tmp_path)
+    prom = (tmp_path / "metrics.prom").read_text()
+    assert "dlbb_sweep_wall_seconds 1.5" in prom
+    assert "dlbb_analysis_numerics_max_rel_error_bound" in prom
+    manifest = json.loads((tmp_path / "sweep_manifest.json").read_text())
+    assert manifest["kind"] == "1d"  # merged, not clobbered
+    audit = manifest["numerics_audit"]
+    assert audit["targets_audited"] == 2
+    report = json.loads((tmp_path / "numerics_audit.json").read_text())
+    assert report["schema"] == "dlbb_numerics_audit_v1"
+
+
+def test_per_pass_finding_count_gauges():
+    """Satellite: obs/export.analysis_metrics seeds a gauge sample for
+    every pass/severity (zeros included — a silently dropped gate must
+    stay visible) and counts real findings per pass."""
+    from dlbb_tpu.analysis.findings import AnalysisReport, Finding
+    from dlbb_tpu.obs.export import analysis_metrics
+
+    report = AnalysisReport()
+    report.suppressed = 3
+    report.findings.append(Finding(
+        pass_name="numerics", rule="convert-churn", severity="error",
+        target="t", message="m"))
+    report.findings.append(Finding(
+        pass_name="lint", rule="jit-in-loop", severity="warning",
+        target="f.py", message="m"))
+    text = analysis_metrics(report).to_prometheus()
+    assert ('dlbb_analysis_findings{pass="numerics",severity="error"} 1'
+            in text)
+    assert ('dlbb_analysis_findings{pass="lint",severity="warning"} 1'
+            in text)
+    # clean passes still export a zero sample
+    assert ('dlbb_analysis_findings{pass="memory",severity="error"} 0'
+            in text)
+    assert "dlbb_analysis_suppressed 3" in text
+
+
+def test_numerics_no_targets_fails_closed(monkeypatch, tmp_path):
+    """The PR-2 vacuous-run contract extends to the numerics pass: an
+    empty target surface must exit 1, not read as a clean audit."""
+    import dlbb_tpu.analysis.hlo_audit as hlo_audit
+    from dlbb_tpu.analysis import run_analysis
+    from dlbb_tpu.analysis.findings import EXIT_FINDINGS
+
+    monkeypatch.setattr(hlo_audit, "default_targets", lambda: [])
+    json_path = tmp_path / "report.json"
+    rc = run_analysis(which="numerics", json_path=str(json_path))
+    assert rc == EXIT_FINDINGS
+    data = json.loads(json_path.read_text())
+    assert [f["rule"] for f in data["findings"]] == ["no-targets-audited"]
+
+
+# ---------------------------------------------------------------------------
+# float64-literal-in-jit source lint
+# ---------------------------------------------------------------------------
+
+
+def _lint(source):
+    from dlbb_tpu.analysis.source_lint import lint_source
+
+    findings, suppressed = lint_source(source, "dlbb_tpu/fake.py")
+    return [f for f in findings if f.rule == "float64-literal-in-jit"], \
+        suppressed
+
+
+def test_float64_in_jitted_function_flagged():
+    findings, _ = _lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x + np.float64(1.0)\n"
+    )
+    assert len(findings) == 1
+    assert "np.float64" in findings[0].message
+
+
+def test_float64_astype_and_dtype_kwargs_flagged():
+    findings, _ = _lint(
+        "import jax, functools\n"
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(x):\n"
+        "    a = x.astype(np.float64)\n"
+        "    b = jnp.zeros((4,), dtype='float64')\n"
+        "    c = np.ones((4,))\n"
+        "    return a, b, c\n"
+    )
+    assert len(findings) == 3
+    descs = " ".join(f.details["expression"] for f in findings)
+    assert ".astype" in descs and "dtype=" in descs and "np.ones" in descs
+
+
+def test_float64_outside_jit_is_clean_and_suppression_works():
+    # host-side float64 statistics are legitimate
+    clean, _ = _lint(
+        "import numpy as np\n"
+        "def summarise(xs):\n"
+        "    return np.float64(sum(xs)) / len(xs)\n"
+    )
+    assert clean == []
+    # jit picked up by name: flagged, then suppressed inline
+    flagged, _ = _lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "def step(x):\n"
+        "    return x.astype(np.float64)\n"
+        "step = jax.jit(step)\n"
+    )
+    assert len(flagged) == 1
+    suppressed_findings, hits = _lint(
+        "import jax\n"
+        "import numpy as np\n"
+        "def step(x):\n"
+        "    return x.astype(np.float64)  "
+        "# comm-lint: disable=float64-literal-in-jit\n"
+        "step = jax.jit(step)\n"
+    )
+    assert suppressed_findings == []
+    assert hits == 1
+
+
+def test_float64_in_timed_region_flagged():
+    findings, _ = _lint(
+        "import time\n"
+        "import numpy as np\n"
+        "def measure(fn):\n"
+        "    t0 = time.perf_counter()\n"
+        "    out = np.asarray([1.5, 2.5])\n"
+        "    dt = time.perf_counter() - t0\n"
+        "    return out, dt\n"
+    )
+    assert len(findings) == 1
+    assert "float literals" in findings[0].details["expression"]
