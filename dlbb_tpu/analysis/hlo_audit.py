@@ -711,15 +711,17 @@ _SERVE_SHAPE = dict(max_batch=4, num_blocks=4, block_size=8, bucket=16)
 
 
 def _serve_cache_bytes_per_device(dp: int, tp: int,
-                                  num_layers: Optional[int] = None) -> int:
+                                  num_layers: Optional[int] = None,
+                                  kv_quantization: str = "none") -> int:
     """Analytic per-device KV-cache footprint of the serving audit
     geometry — the SAME ``models.configs.kv_cache_bytes_per_device``
     the build-time HBM budget gate prices, wired into the decode/prefill
     expectations as ``donated_bytes_expected`` so the memory audit's
     ``serving-cache-drift`` rule pins formula and compiled program to
     each other.  ``num_layers`` overrides the tiny model's depth — the
-    speculative draft plane (1 layer) prices through the same
-    formula."""
+    speculative draft plane (1 layer) prices through the same formula;
+    ``kv_quantization="int8"`` prices the quantized layout (int8 data
+    planes + the per-(block, kv-head) fp32 scale side-channel)."""
     from dlbb_tpu.models.configs import (
         ModelConfig,
         kv_cache_bytes_per_device,
@@ -733,6 +735,8 @@ def _serve_cache_bytes_per_device(dp: int, tp: int,
         _SERVE_SHAPE["max_batch"],
         _SERVE_SHAPE["num_blocks"] * _SERVE_SHAPE["block_size"],
         dp=dp, tp=tp,
+        kv_quantization=kv_quantization,
+        block_size=_SERVE_SHAPE["block_size"],
     )
 
 
@@ -841,6 +845,24 @@ def _serve_build(dp: int, tp: int, what: str, k: int = 4):
         xc = jnp.zeros((1, chunk, cfg.hidden_size), jnp.float32)
         return fn, (cache, (pk, pk), params, xc, np.int32(0),
                     np.int32(2 * chunk))
+    if what == "prefix_attach":
+        # one matched block copied donor -> destination slot plus the
+        # dequantised fp prefix carry — the shared-prefix admission's
+        # entire device program (dp=1 by contract, like compaction)
+        from dlbb_tpu.serve.engine import build_prefix_attach
+
+        fn = build_prefix_attach(cfg, mesh, _SERVE_SHAPE["block_size"],
+                                 _SERVE_SHAPE["block_size"])
+        return fn, (cache, np.int32(0), np.int32(1))
+    if what == "decode_quant":
+        from dlbb_tpu.serve.kvcache import create_quant_kv_cache
+
+        qcache = create_quant_kv_cache(
+            cfg, _SERVE_SHAPE["max_batch"], _SERVE_SHAPE["num_blocks"],
+            _SERVE_SHAPE["block_size"], mesh=mesh,
+        )
+        fn = build_decode_step(cfg, mesh, quantized=True)
+        return fn, ((qcache, x), params, active)
     if what in ("compact_gather", "compact_scatter"):
         bucket = _SERVE_SHAPE["max_batch"] // 2
         idx = jnp.arange(bucket, dtype=jnp.int32)
@@ -1134,6 +1156,78 @@ def _compact_target(what: str, tp: int = 4) -> AuditTarget:
     )
 
 
+def _prefix_attach_target(tp: int = 4) -> AuditTarget:
+    """The shared-prefix attach jit (``serve/engine.py::prefix_attach``,
+    dp=1 by contract): a masked-select copy of the donor slot's matched
+    blocks into the destination slot plus the dequantised fp prefix
+    carry.  Pure LOCAL data movement — the slot dim is unsharded and
+    the kv-head shard is untouched, so the lowering must contain ZERO
+    collectives: a shared-prefix prefill that costs even one extra
+    collective has no TTFT story.  The donated carry is the cache (the
+    serving-cache-drift pin extends to the attach program)."""
+    from dlbb_tpu.analysis.expectations import compact_expectation
+
+    def build():
+        return _serve_build(1, tp, "prefix_attach")
+
+    exp = compact_expectation()
+    cache_dev = _serve_cache_bytes_per_device(1, tp)
+    # the full donated cache + the one-block prefix carry + the masked
+    # copy's transient
+    exp.max_peak_bytes = int(2.2 * cache_dev)
+    exp.donated_bytes_expected = cache_dev
+    return AuditTarget(
+        name="serve/engine.py::prefix_attach[tp]",
+        build=build,
+        expectation=exp,
+        min_devices=tp,
+    )
+
+
+def _decode_quant_target(tp: int = 4) -> AuditTarget:
+    """The int8-KV decode step (``serve/engine.py::decode_step`` with
+    ``serving.kv_quantization=int8``, dp=1 — the prefix/quant serving
+    envelope): same tiny-collectives contract as the fp decode target,
+    but the donated carry and the peak ceiling are priced from the
+    QUANTIZED layout — int8 data planes + fp32 per-(block, kv-head)
+    scales, ~4x smaller than fp32 planes.  This is the static proof of
+    the capacity claim: if the compiled carry were still fp-sized, the
+    donation pin (serving-cache-drift) trips on the analytic int8
+    number."""
+    def build():
+        return _serve_build(1, tp, "decode_quant")
+
+    qkv_width = 3 * _TINY_MODEL["hidden_size"]
+    act_bytes = _SERVE_SHAPE["max_batch"] * qkv_width * 4
+    cache_q = _serve_cache_bytes_per_device(1, tp,
+                                            kv_quantization="int8")
+    # dequantise-to-fp32 transients: each scanned layer materialises one
+    # layer's fp32 view of its k/v planes (cache_q * ~4 / num_layers per
+    # plane pair) — bounded inside the peak term below
+    fp_layer = 4 * cache_q // _TINY_MODEL["num_layers"]
+    # the donated carry also holds the [B, 1, H] f32 hidden state and
+    # the int32 lengths vector — negligible against fp planes but >10%
+    # of the 4x-smaller int8 cache, so the pin must price them
+    carry_extra = _SERVE_SHAPE["max_batch"] * (
+        _TINY_MODEL["hidden_size"] * 4 + 4)
+    return AuditTarget(
+        name="serve/engine.py::decode_step[int8,tp]",
+        build=build,
+        expectation=TargetExpectation(
+            allowed=plan_expected_kinds(dp=1, tp=tp, decode=True),
+            required_any={"all-reduce"},
+            min_required=1,
+            max_bytes_per_instr=int(act_bytes * 1.25),
+            expect_donation=True,
+            max_peak_bytes=int(
+                1.3 * (_tiny_params_bytes() // tp + cache_q + fp_layer)
+            ) + 16 * act_bytes,
+            donated_bytes_expected=cache_q + carry_extra,
+        ),
+        min_devices=tp,
+    )
+
+
 def _train_step_target(zero_stage: int, dp: int = 8) -> AuditTarget:
     def build():
         import jax
@@ -1212,10 +1306,12 @@ def default_targets() -> list[AuditTarget]:
     and without the overlapped collective-matmul schedule, the
     DDP + ZeRO-1 + overlapped-TP train steps, and the serving programs
     — per-step decode + monolithic prefill plus the decode fast path
-    (fused K-step scan, chunked prefill, compaction gather/scatter) and
-    the speculative-decoding programs (token-feedback fused scan,
-    γ-token verify step, draft-model proposal scan) — all
-    tiny-collectives-only with the cache-regather byte gate."""
+    (fused K-step scan, chunked prefill, compaction gather/scatter), the
+    speculative-decoding programs (token-feedback fused scan, γ-token
+    verify step, draft-model proposal scan), and the prefix/quant cache
+    programs (zero-collective shared-prefix attach, int8-KV decode with
+    the quantized-layout donation pin) — all tiny-collectives-only with
+    the cache-regather byte gate."""
     targets = registry_op_targets()
     targets.append(_barrier_target())
     targets.append(_tp_forward_target())
@@ -1236,6 +1332,8 @@ def default_targets() -> list[AuditTarget]:
     targets.append(_prefill_chunk_target())
     targets.append(_compact_target("compact_gather"))
     targets.append(_compact_target("compact_scatter"))
+    targets.append(_prefix_attach_target())
+    targets.append(_decode_quant_target())
     return targets
 
 

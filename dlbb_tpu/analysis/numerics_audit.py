@@ -35,8 +35,11 @@ Rules (all findings carry the analytic details):
   straight back into a quantise (fp->narrow convert) through nothing but
   scaling/layout ops: the roundtrip did no arithmetic work and only
   re-rounded.  The compression kernels' legitimate requantise always
-  accumulates between the two (``comm/compression.py`` ring hops), so
-  they stay clean.
+  accumulates between the two (``comm/compression.py`` ring hops), and
+  a select that merges another *live* data stream into the window (the
+  int8 decode append overwriting the fresh token's K/V) is likewise
+  real work — both abort the trace, so they stay clean.  A masking
+  select against a constant fill is layout-only and keeps tracing.
 - ``nondeterministic-reduction`` — an fp all-reduce / reduce-scatter
   whose replica-group reduction order is backend-scheduled: counted per
   target always (meta), an error only when the target claims bitwise
@@ -230,8 +233,8 @@ def _data_operands(instr: HloInstruction) -> Optional[list[str]]:
         return list(instr.operands[:1])
     if op == "clamp":
         return [instr.operands[1]] if len(instr.operands) >= 2 else None
-    if op == "select":
-        return list(instr.operands[1:3])
+    # select is handled in _find_dequant (needs producer context to tell
+    # a masking fill from a merge of two live data streams)
     if op in _BIN_SCALE:
         if len(instr.operand_arrays) >= 2:
             e0 = _elems(instr.operand_arrays[0][1])
@@ -247,6 +250,40 @@ def _data_operands(instr: HloInstruction) -> Optional[list[str]]:
             return None  # equal-size combine: genuine accumulation
         return list(instr.operands[:1])
     return None
+
+
+def _is_masking_fill(
+    module: HloModule,
+    comp: HloComputation,
+    operand_name: str,
+    sites: dict,
+    max_steps: int = 16,
+) -> bool:
+    """True when ``%operand_name`` is a constant-like fill — a
+    constant/iota, or a broadcast/layout chain over one.  A select with
+    a fill on one side is a masking/padding op (layout-only); a select
+    whose both sides carry computed data MERGES two live streams and is
+    real arithmetic work.  Unresolvable producers count as live data
+    (conservative: the merge aborts the roundtrip trace, and a masking
+    fill is always resolvable — constants don't hide behind loop
+    parameters)."""
+    work = list(resolve_producers(module, comp, operand_name, sites))
+    if not work:
+        return False
+    steps = 0
+    while work and steps < max_steps:
+        c, instr = work.pop()
+        steps += 1
+        if instr.opcode in ("constant", "iota"):
+            continue
+        if instr.opcode in _PASS_UNARY and instr.operands:
+            nxt = resolve_producers(module, c, instr.operands[0], sites)
+            if not nxt:
+                return False
+            work.extend(nxt)
+            continue
+        return False
+    return not work  # ran out of steps with work left -> not provably a fill
 
 
 def _find_dequant(
@@ -280,6 +317,17 @@ def _find_dequant(
             if src in QUANT_DTYPES and _is_fp(instr.dtype):
                 return c, instr
             continue  # any other convert changes meaning: abort this path
+        if instr.opcode == "select" and len(instr.operands) >= 3:
+            # masking select (other side a constant fill): layout-only,
+            # keep tracing through the data side.  Both sides live:
+            # the select merges two data streams (e.g. the int8 decode
+            # append writing the fresh token over the dequantised
+            # window) — real work, abort this path.
+            a, b = instr.operands[1], instr.operands[2]
+            follow = [o for o, sib in ((a, b), (b, a))
+                      if _is_masking_fill(module, c, sib, sites)]
+            push(c, follow)
+            continue
         follow = _data_operands(instr)
         if follow is None:
             continue

@@ -368,6 +368,32 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="spec_adaptive",
                     help="per-request adaptive γ: back off to a smaller "
                          "verify width on low acceptance EMA")
+    sv.add_argument("--prefix-caching", action="store_true", default=None,
+                    dest="prefix_caching",
+                    help="shared-prefix KV reuse: content-address full "
+                         "blocks in a host-side radix trie, attach new "
+                         "admissions to a donor's matched blocks (one "
+                         "masked copy replaces the matched chunks' "
+                         "prefill — requires --prefill-chunk, dp=1; "
+                         "docs/serving.md, 'Prefix cache & quantized "
+                         "KV')")
+    sv.add_argument("--kv-quantization", default=None,
+                    dest="kv_quantization", choices=["none", "int8"],
+                    help="KV-cache plane dtype: int8 stores K/V blocks "
+                         "quantized with per-(block, kv-head) fp32 "
+                         "scales — ~4x smaller cache under the same "
+                         "hbm_budget_gb (docs/serving.md)")
+    sv.add_argument("--prefix-groups", type=int, default=None,
+                    dest="prefix_groups", metavar="G",
+                    help="generated traces only: split requests into G "
+                         "seeded populations sharing a common prompt "
+                         "prefix (the system-prompt traffic shape the "
+                         "prefix cache exploits)")
+    sv.add_argument("--prefix-len", type=int, default=None,
+                    dest="prefix_len", metavar="TOKENS",
+                    help="shared-prefix length for --prefix-groups "
+                         "(clamped per request to prompt_len - 1; "
+                         "default: the prompt-range midpoint)")
     sv.add_argument("--slo", type=float, default=None, metavar="SEC",
                     help="per-request deadline (SLO) stamped on every "
                          "generated request: queued requests whose wait "
@@ -795,13 +821,24 @@ def _dispatch(args) -> int:
                 "max_dispatch_retries": args.max_dispatch_retries,
                 "dispatch_deadline_factor":
                     args.dispatch_deadline_factor,
+                "prefix_caching": args.prefix_caching,
+                "kv_quantization": args.kv_quantization,
             },
             resume=args.resume,
             fault_plan=args.fault_plan,
             slo=args.slo,
             device_trace=args.device_trace,
+            prefix_groups=args.prefix_groups,
+            prefix_len=args.prefix_len,
         )
         req = result["requests"]
+        if result.get("prefix", {}).get("enabled"):
+            pre = result["prefix"]
+            print(
+                f"prefix cache: {pre['hits']} hit(s), "
+                f"{pre['tokens_reused']} token(s) reused "
+                f"(hit rate {pre['hit_rate']:.2f})"
+            )
         if result.get("preempted"):
             print(
                 f"preempted after {req['completed']} completed "

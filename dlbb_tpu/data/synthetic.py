@@ -51,13 +51,35 @@ class SyntheticEmbeddingDataset:
 
 def _request_host_embeddings(seed: int, prompt_len: int,
                              hidden_size: int,
-                             period: Optional[int] = None) -> np.ndarray:
+                             period: Optional[int] = None,
+                             prefix_len: Optional[int] = None,
+                             prefix_seed: Optional[int] = None) -> np.ndarray:
     """The host-side float32 prompt array both :func:`request_embeddings`
     and :func:`prompt_token_ids` derive from — ONE rng consumption
     pattern, so the device prompt and its host-side token-id view can
     never drift.  ``period`` tiles a seeded motif of that many positions
     (the repeating-structure traffic variant, ``serve/traffic.py``);
-    None keeps the original draw byte-identical."""
+    None keeps the original draw byte-identical.
+
+    ``prefix_len``/``prefix_seed`` compose the shared-prefix traffic
+    variant: the first ``prefix_len`` positions are drawn from
+    ``prefix_seed`` (the GROUP seed — every request in a prefix group
+    gets the bit-identical prefix, which is what makes its token-block
+    chain content-addressable in the prefix trie), the remainder from
+    the per-request ``seed``.  The per-seed draws are prefix-closed
+    (``default_rng`` fills row-major), so requests whose clamped prefix
+    lengths differ still share their common head."""
+    if prefix_len is not None and prefix_seed is not None and prefix_len > 0:
+        if prefix_len >= prompt_len:
+            raise ValueError(
+                f"prefix_len={prefix_len} must leave at least one "
+                f"per-request position (prompt_len={prompt_len})"
+            )
+        head = _request_host_embeddings(prefix_seed, prefix_len,
+                                        hidden_size, period=period)
+        tail = _request_host_embeddings(seed, prompt_len - prefix_len,
+                                        hidden_size, period=period)
+        return np.concatenate([head, tail], axis=1)
     rng = np.random.default_rng(seed)
     if period is not None:
         if period < 1:
@@ -77,6 +99,8 @@ def request_embeddings(
     dtype=jnp.bfloat16,
     pad_to: Optional[int] = None,
     period: Optional[int] = None,
+    prefix_len: Optional[int] = None,
+    prefix_seed: Optional[int] = None,
 ) -> jax.Array:
     """Seeded synthetic prompt embeddings for ONE serving request:
     ``[1, prompt_len, hidden]`` (``[1, pad_to, hidden]`` when padded for a
@@ -96,7 +120,8 @@ def request_embeddings(
             f"pad_to={pad_to} is shorter than prompt_len={prompt_len}"
         )
     host = _request_host_embeddings(seed, prompt_len, hidden_size,
-                                    period=period)
+                                    period=period, prefix_len=prefix_len,
+                                    prefix_seed=prefix_seed)
     if pad_to is not None and pad_to > prompt_len:
         host = np.concatenate(
             [host, np.zeros((1, pad_to - prompt_len, hidden_size),
@@ -106,14 +131,17 @@ def request_embeddings(
 
 
 def prompt_token_ids(seed: int, prompt_len: int, hidden_size: int,
-                     period: Optional[int] = None) -> list[int]:
+                     period: Optional[int] = None,
+                     prefix_len: Optional[int] = None,
+                     prefix_seed: Optional[int] = None) -> list[int]:
     """The prompt's greedy token-id view: per-position argmax of the SAME
     host array :func:`request_embeddings` uploads — the n-gram drafter's
     prompt-lookup context (``serve/engine.py``).  Pure numpy, computed at
     admission: drafting hints never need device transfers, and a wrong
     hint costs only acceptance (the target verify gates every commit)."""
     host = _request_host_embeddings(seed, prompt_len, hidden_size,
-                                    period=period)
+                                    period=period, prefix_len=prefix_len,
+                                    prefix_seed=prefix_seed)
     return [int(t) for t in np.argmax(host[0], axis=-1)]
 
 

@@ -266,31 +266,62 @@ SERVABLE_ATTENTION = ("full", "dense")
 
 _DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4}
 
+# serving.kv_quantization values the paged cache supports: "int8"
+# stores K/V blocks as int8 with one fp32 scale per (layer, slot,
+# block, kv-head) as a side-channel plane (serve/kvcache.QuantKVCache).
+KV_QUANTIZATION_MODES = ("none", "int8")
+
 
 def kv_cache_bytes_raw(num_layers: int, max_batch: int, max_seq: int,
                        kv_heads: int, head_dim: int,
-                       dtype: str = "bfloat16") -> int:
+                       dtype: str = "bfloat16",
+                       kv_quantization: str = "none",
+                       block_size: Optional[int] = None) -> int:
     """The one KV-cache footprint formula, on raw geometry (for callers
     holding a serialized model record instead of a ModelConfig — e.g.
     ``obs/attribution.py`` pricing a run's report): K + V, every layer,
-    every slot, ``max_seq`` tokens at GQA ``kv_heads`` width."""
-    return (2 * num_layers * max_batch * max_seq * kv_heads * head_dim
-            * _DTYPE_BYTES.get(dtype, 2))
+    every slot, ``max_seq`` tokens at GQA ``kv_heads`` width.
+
+    ``kv_quantization="int8"`` prices the quantized layout instead:
+    1 byte per K/V element plus the fp32 scale side-channel (one scale
+    per block per kv-head, needing ``block_size``)."""
+    if kv_quantization not in KV_QUANTIZATION_MODES:
+        raise ValueError(
+            f"kv_quantization={kv_quantization!r} not in "
+            f"{KV_QUANTIZATION_MODES}"
+        )
+    elems = 2 * num_layers * max_batch * max_seq * kv_heads
+    if kv_quantization == "int8":
+        if block_size is None or block_size < 1 or max_seq % block_size:
+            raise ValueError(
+                "kv_quantization='int8' needs a positive block_size "
+                f"dividing max_seq={max_seq} to price the per-block "
+                f"scale plane (got block_size={block_size})"
+            )
+        # int8 data + fp32 scales [L, B, num_blocks, kvh] for K and V
+        return elems * head_dim + (elems // block_size) * 4
+    return elems * head_dim * _DTYPE_BYTES.get(dtype, 2)
 
 
 def kv_cache_bytes(config: ModelConfig, max_batch: int,
-                   max_seq: int) -> int:
+                   max_seq: int, kv_quantization: str = "none",
+                   block_size: Optional[int] = None) -> int:
     """Total (unsharded) KV-cache footprint of a serving config: K + V,
     every layer, every slot, ``max_seq`` tokens at GQA ``kv_heads``
-    width, in the model dtype."""
+    width, in the model dtype (or the int8 + fp32-scale layout when
+    quantized)."""
     return kv_cache_bytes_raw(config.num_layers, max_batch, max_seq,
                               config.kv_heads, config.head_dim,
-                              config.dtype)
+                              config.dtype,
+                              kv_quantization=kv_quantization,
+                              block_size=block_size)
 
 
 def kv_cache_bytes_per_device(config: ModelConfig, max_batch: int,
                               max_seq: int, dp: int = 1,
-                              tp: int = 1) -> int:
+                              tp: int = 1,
+                              kv_quantization: str = "none",
+                              block_size: Optional[int] = None) -> int:
     """Per-device KV-cache footprint under the serving sharding contract
     (slot dim over dp, kv-head dim over tp) — the ONE number both the
     build-time HBM budget gate (``validate_serving``) and the static
@@ -298,15 +329,20 @@ def kv_cache_bytes_per_device(config: ModelConfig, max_batch: int,
     (``analysis/memory_audit.py``, rule ``serving-cache-drift``) price,
     so the two can never drift apart: the audit pins this formula
     against the donated cache-carry bytes of the compiled decode
-    program."""
+    program.  The scale side-channel of the int8 layout shards over the
+    same dp × tp axes as the data it scales, so one divisor covers
+    both."""
     shards = max(1, dp) * (tp if tp > 1 else 1)
-    return kv_cache_bytes(config, max_batch, max_seq) // shards
+    return kv_cache_bytes(config, max_batch, max_seq,
+                          kv_quantization=kv_quantization,
+                          block_size=block_size) // shards
 
 
 def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
                      block_size: int, dp: int = 1, tp: int = 1,
                      hbm_budget_bytes: Optional[int] = None,
-                     draft_config: Optional[ModelConfig] = None) -> None:
+                     draft_config: Optional[ModelConfig] = None,
+                     kv_quantization: str = "none") -> None:
     """Reject serving configurations the engine cannot run — at build
     time, with a clear error, never as an OOM (or a wrong answer) in the
     middle of a trace.
@@ -325,7 +361,16 @@ def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
     identical), and its resident weights + second KV-cache plane are
     priced INTO the HBM budget alongside the target cache — an
     infeasible ``(spec, max_batch, gamma)`` combination fails here at
-    build time, not as an OOM mid-trace."""
+    build time, not as an OOM mid-trace.
+
+    ``kv_quantization="int8"`` prices the quantized cache layout (int8
+    data + fp32 per-block scales) against the budget — the capacity
+    lever that admits more resident requests per HBM byte."""
+    if kv_quantization not in KV_QUANTIZATION_MODES:
+        raise ValueError(
+            f"serving.kv_quantization={kv_quantization!r} not in "
+            f"{KV_QUANTIZATION_MODES}"
+        )
     if config.attention not in SERVABLE_ATTENTION:
         raise ValueError(
             f"serving requires attention in {SERVABLE_ATTENTION} "
@@ -374,7 +419,8 @@ def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
             ) from e
     if hbm_budget_bytes is not None:
         per_device = kv_cache_bytes_per_device(
-            config, max_batch, max_seq, dp=dp, tp=tp)
+            config, max_batch, max_seq, dp=dp, tp=tp,
+            kv_quantization=kv_quantization, block_size=block_size)
         draft_bytes = 0
         if draft_config is not None:
             # the draft plane is resident for the whole trace: weights
@@ -398,8 +444,11 @@ def validate_serving(config: ModelConfig, max_batch: int, max_seq: int,
                 f"per device (max_batch={max_batch} x max_seq={max_seq} "
                 f"x {config.num_layers} layers x kv_heads="
                 f"{config.kv_heads} x head_dim={config.head_dim} x 2 "
-                f"(K+V) x {_DTYPE_BYTES[config.dtype]} B "
-                f"[{config.dtype}], sharded over dp={dp} x tp={tp})"
+                "(K+V), "
+                + (f"int8 + fp32 scales per {block_size}-token block"
+                   if kv_quantization == "int8"
+                   else f"{_DTYPE_BYTES[config.dtype]} B [{config.dtype}]")
+                + f", sharded over dp={dp} x tp={tp})"
                 f"{draft_note} "
                 f"exceeds the HBM budget of "
                 f"{hbm_budget_bytes / 2**30:.2f} GiB — shrink max_batch/"

@@ -298,9 +298,28 @@ def serving_metrics(report: dict[str, Any],
                 registry.set_gauge(metric, summary[q], quantile=q)
     cache = report.get("cache", {})
     for k in ("blocks_in_use", "peak_blocks_in_use",
-              "peak_blocks_reserved", "total_blocks"):
+              "peak_blocks_reserved", "total_blocks", "shared_blocks",
+              "peak_shared_blocks", "cow_blocks", "prefix_refs"):
         if k in cache:
             registry.set_gauge("serve_cache_blocks", cache[k], stat=k)
+    # prefix cache: the hit/reuse counters (serve_prefix_hits_total /
+    # serve_prefix_tokens_reused_total) are live ENGINE metrics; when
+    # folding a bare report into a fresh registry, seed the totals from
+    # the report's prefix sub-dict so the export is self-contained
+    pre = report.get("prefix", {})
+    if pre.get("enabled"):
+        if registry.get("serve_prefix_hits") == 0:
+            registry.inc("serve_prefix_hits", pre.get("hits", 0),
+                         help="admissions that attached to a trie-matched "
+                              "shared prefix")
+            registry.inc("serve_prefix_tokens_reused",
+                         pre.get("tokens_reused", 0),
+                         help="prompt tokens served from shared blocks "
+                              "instead of prefill compute")
+        if pre.get("hit_rate") is not None:
+            registry.set_gauge("serve_prefix_hit_rate", pre["hit_rate"],
+                               help="prefix-attached fraction of "
+                                    "prefills this run")
     return registry
 
 

@@ -407,6 +407,19 @@ def journal_to_trace(journal_dir: "str | Path",
                 "args": _jsonable(args),
             })
             continue
+        if name in ("prefix-attach", "prefix-cow"):
+            # the prefix-cache pair: an attach instant labelled with its
+            # donor/reuse (the TTFT story of that admission) and its CoW
+            # sibling when the trie matched past the attach cap — own
+            # category so a Perfetto query can line hit rate up against
+            # the prefill spans
+            label = f"{name}[{config}]" if config else name
+            events.append({
+                "name": label, "cat": "prefix-cache", "ph": "i",
+                "s": "p", "ts": ts_us, "pid": pid, "tid": 1,
+                "args": _jsonable(args),
+            })
+            continue
         events.append({
             "name": name, "cat": "journal", "ph": "i", "s": "t",
             "ts": ts_us, "pid": pid, "tid": 1, "args": _jsonable(args),

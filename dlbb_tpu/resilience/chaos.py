@@ -364,6 +364,36 @@ def _class_serve(work: Path, log: Callable[[str], None]) -> None:
     log("serve cache-torn: half-applied accounting rolled back to the "
         "pre-dispatch snapshot and replayed; ledger consistent")
 
+    # -- torn accounting over REFCOUNTED shared blocks: the rollback
+    #    snapshot covers the prefix trie and its refcounts too, so a
+    #    replayed decode unit neither double-frees a shared block (a
+    #    torn release re-applied) nor leaks one (a torn attach dropped)
+    out = work / "serve_torn_prefix"
+    ptrace = generate_trace("poisson", 10, seed=5, rate=200.0,
+                            prompt_range=(17, 28), output_range=(3, 6),
+                            prefix_groups=2, prefix_len=16)
+    pcfg = cfg("cp", prefill_chunk=8, prefix_caching=True)
+    # prefix caching is a dp=1 feature (every slot's blocks live on one
+    # dp shard, so a donor copy is shard-local)
+    pcfg["parallelism"] = {"data_parallel": 1, "world_size": 4}
+    prep = run_serving(pcfg, ptrace, str(out), verbose=False,
+                       fault_plan="serve-cache-torn:1")
+    _check(prep["requests"]["completed"] == len(ptrace),
+           "torn refcount bookkeeping did not recover")
+    _check(prep["resilience"]["retries"] >= 1,
+           "torn refcount bookkeeping was not replayed")
+    _check(prep["prefix"]["hits"] >= 1,
+           "prefix trace produced no shared-prefix attach")
+    _check(prep["cache"]["blocks_reserved"] == 0,
+           "refcounted ledger left dangling reservations after rollback")
+    _check(prep["cache"]["shared_blocks"] == 0,
+           "prefix trie leaked shared blocks after drain "
+           f"({prep['cache']})")
+    _check(prep["cache"]["prefix_refs"] == 0,
+           f"prefix trie leaked refcounts after drain ({prep['cache']})")
+    log("serve cache-torn (prefix): refcounts + trie rolled back with "
+        "the ledger; no double-free, no leaked shared block")
+
     # -- permanent decode failure: affected requests fail CLOSED with
     #    chains; the run itself survives
     out = work / "serve_perm"

@@ -66,7 +66,9 @@ def _hbm_record(model_cfg: ModelConfig, serving_cfg: ServingConfig,
 
     cache_dev = kv_cache_bytes_per_device(
         model_cfg, serving_cfg.max_batch, serving_cfg.max_seq,
-        dp=plan.dp, tp=plan.tp)
+        dp=plan.dp, tp=plan.tp,
+        kv_quantization=serving_cfg.kv_quantization,
+        block_size=serving_cfg.block_size)
     budget = (None if serving_cfg.hbm_budget_gb is None
               else int(serving_cfg.hbm_budget_gb * 2**30))
     return {
@@ -393,10 +395,24 @@ def merge_reports(partial: dict[str, Any],
     }
 
     cache = dict(resumed.get("cache", {}))
-    for key in ("peak_blocks_reserved", "peak_blocks_in_use"):
+    for key in ("peak_blocks_reserved", "peak_blocks_in_use",
+                "peak_shared_blocks"):
         cache[key] = max(partial.get("cache", {}).get(key, 0),
                          resumed.get("cache", {}).get(key, 0))
+    cache["cow_blocks"] = (partial.get("cache", {}).get("cow_blocks", 0)
+                           + resumed.get("cache", {}).get("cow_blocks", 0))
     merged["cache"] = cache
+
+    if "prefix" in partial or "prefix" in resumed:
+        pre_a = partial.get("prefix", {})
+        pre_b = resumed.get("prefix", {})
+        prefix = dict(pre_b) or dict(pre_a)
+        for key in ("hits", "tokens_reused", "cow_blocks"):
+            prefix[key] = pre_a.get(key, 0) + pre_b.get(key, 0)
+        prefills = len(raw["prefill_s"])
+        prefix["hit_rate"] = (prefix.get("hits", 0) / prefills
+                              if prefills else 0.0)
+        merged["prefix"] = prefix
 
     # timeseries: the resumed session re-anchored its clock, so its
     # samples are offset by the partial session's wall
@@ -571,6 +587,8 @@ def run_serve_from_config(
     fault_plan: Optional[str] = None,
     slo: Optional[float] = None,
     device_trace: Optional[str] = None,
+    prefix_groups: Optional[int] = None,
+    prefix_len: Optional[int] = None,
 ) -> dict[str, Any]:
     """CLI entry: optional experiment YAML + flag overrides (including
     the decode fast-path knobs — decode_horizon / inflight_window /
@@ -578,7 +596,9 @@ def run_serve_from_config(
     docs/serving.md).  ``--resume`` finishes a preempted run from its
     ``serving_resume.json`` checkpoint; ``--slo SEC`` stamps generated
     requests with a per-request deadline; ``--fault-plan`` activates
-    the chaos harness.
+    the chaos harness; ``--prefix-groups``/``--prefix-len`` generate a
+    shared-prefix trace (docs/serving.md, "Prefix cache & quantized
+    KV") — the traffic shape the ``prefix_caching`` engine exploits.
 
     Without ``--config`` the default small GQA model serves on an
     auto-planned (dp, tp) mesh over the available devices."""
@@ -606,9 +626,14 @@ def run_serve_from_config(
         dp, tp = default_parallelism(n, model_cfg.kv_heads,
                                      serving_cfg.max_batch)
         config["parallelism"] = {"data_parallel": dp, "world_size": tp}
+    trace_kw: dict[str, Any] = {}
+    if prefix_groups is not None:
+        trace_kw["prefix_groups"] = prefix_groups
+    if prefix_len is not None:
+        trace_kw["prefix_len"] = prefix_len
     resolved = resolve_trace(trace, num_requests=num_requests, seed=seed,
                              rate=rate, serving=serving_cfg,
-                             deadline_s=slo)
+                             deadline_s=slo, **trace_kw)
     out = output_dir or config.get("experiment", {}).get(
         "output_dir", "results/serving")
     return run_serving(config, resolved, output_dir=out, devices=devices,

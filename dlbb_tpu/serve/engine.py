@@ -130,8 +130,13 @@ from dlbb_tpu.resilience.preempt import PreemptionGuard
 from dlbb_tpu.serve.kvcache import (
     BlockLedger,
     KVCache,
+    QuantKVCache,
     cache_shardings,
     create_kv_cache,
+    create_quant_kv_cache,
+    dequantize_kv_blocks,
+    quant_cache_shardings,
+    quantize_kv_blocks,
 )
 from dlbb_tpu.serve.traffic import Request, TrafficTrace
 from dlbb_tpu.utils.metrics import Timer, summarize
@@ -238,6 +243,30 @@ class ServingConfig:
                      transformer; every other dim matches the target).
     spec_draft_kv_heads: draft-model GQA kv_heads override (None =
                      the target's; must keep kv_heads % tp == 0).
+    prefix_caching:  refcounted content-addressed shared-prefix KV
+                     blocks (docs/serving.md, "Prefix cache & quantized
+                     KV").  Full prompt blocks are indexed by their
+                     token-block chain in a host-side radix trie inside
+                     the ``BlockLedger``; an admitted request whose
+                     prompt matches an existing chain attaches to the
+                     matched blocks (one copy-on-attach jit replaces
+                     the matched chunks' prefills — TTFT drops by the
+                     matched fraction) and pays blocks only for its
+                     unmatched suffix.  Requires ``prefill_chunk`` (the
+                     suffix-only prefill IS the chunk machinery),
+                     dp=1 (the donor->slot block copy must stay
+                     shard-local, like compaction), and
+                     speculation="off".
+    kv_quantization: "none" (fp cache, bit-identical legacy layout) or
+                     "int8": K/V planes stored as int8 blocks with a
+                     per-(block, kv-head) fp32 scale side-channel
+                     plane, dequantised inside the length-masked
+                     attention — ~3.9x smaller cache, so
+                     ``hbm_budget_gb`` admits proportionally more
+                     resident requests (``kv_cache_bytes_per_device``
+                     prices the quantized layout statically).
+                     Requires speculation="off" and no
+                     compact_threshold (fp-cache-only programs).
     """
 
     max_batch: int = 8
@@ -261,6 +290,8 @@ class ServingConfig:
     spec_adaptive: bool = False
     spec_draft_layers: int = 1
     spec_draft_kv_heads: Optional[int] = None
+    prefix_caching: bool = False
+    kv_quantization: str = "none"
 
     def __post_init__(self) -> None:
         if not self.prefill_buckets:
@@ -304,7 +335,8 @@ class ServingConfig:
                  if self.speculation == "draft-model" else None)
         validate_serving(config, self.max_batch, self.max_seq,
                          self.block_size, dp=dp, tp=tp,
-                         hbm_budget_bytes=budget, draft_config=draft)
+                         hbm_budget_bytes=budget, draft_config=draft,
+                         kv_quantization=self.kv_quantization)
         for b in self.prefill_buckets:
             if b % self.block_size != 0 or not 0 < b <= self.max_seq:
                 raise ValueError(
@@ -459,6 +491,49 @@ class ServingConfig:
                     "chunked target prefill would leave it silently "
                     "unfilled"
                 )
+        # -- shared-prefix cache + quantized KV planes (same no-op-trap
+        #    contract: a knob that cannot engage is a config error) --
+        if self.prefix_caching:
+            if self.prefill_chunk is None:
+                raise ValueError(
+                    "serving.prefix_caching requires prefill_chunk: the "
+                    "suffix-only prefill of a prefix hit IS the chunked-"
+                    "prefill machinery (attach replaces the matched "
+                    "chunks), so without it every admission would pay "
+                    "the full prefill and the trie would be a silent "
+                    "no-op"
+                )
+            if dp > 1:
+                raise ValueError(
+                    "serving.prefix_caching requires dp=1: the prefix "
+                    "attach copies donor-slot blocks into the admitted "
+                    "slot, and that copy must stay shard-local — the "
+                    f"slot dim is sharded over dp={dp} (same constraint "
+                    "as compact_threshold)"
+                )
+            if self.speculation != "off":
+                raise ValueError(
+                    "serving.prefix_caching cannot combine with "
+                    f"speculation={self.speculation!r}: prefix attach "
+                    "rides the chunked prefill, which the speculative "
+                    "modes exclude (and generated tokens are never "
+                    "indexed in the trie, so drafting gains nothing)"
+                )
+        if self.kv_quantization == "int8":
+            if self.speculation != "off":
+                raise ValueError(
+                    "serving.kv_quantization='int8' cannot combine with "
+                    f"speculation={self.speculation!r}: the token/"
+                    "verify programs read and write the fp cache layout "
+                    "only"
+                )
+            if self.compact_threshold is not None:
+                raise ValueError(
+                    "serving.kv_quantization='int8' cannot combine with "
+                    "compact_threshold: the slot gather/scatter programs "
+                    "repack the fp cache layout only, so compaction "
+                    "would silently run on stale scale planes"
+                )
 
     @property
     def spec_drafting(self) -> bool:
@@ -511,7 +586,8 @@ class ServingConfig:
                   "retry_backoff_s", "dispatch_deadline_factor",
                   "dispatch_deadline_min_s", "speculation", "spec_gamma",
                   "spec_adaptive", "spec_draft_layers",
-                  "spec_draft_kv_heads"):
+                  "spec_draft_kv_heads", "prefix_caching",
+                  "kv_quantization"):
             if k in d:
                 fields[k] = d[k]
         if "prefill_buckets" in d:
@@ -542,6 +618,8 @@ class ServingConfig:
             "spec_adaptive": self.spec_adaptive,
             "spec_draft_layers": self.spec_draft_layers,
             "spec_draft_kv_heads": self.spec_draft_kv_heads,
+            "prefix_caching": self.prefix_caching,
+            "kv_quantization": self.kv_quantization,
         }
 
     @property
@@ -648,20 +726,47 @@ def _write_prompt_blocks(cache_layer: jax.Array, update: jax.Array,
     return jnp.where(slot_mask & blk_mask, padded[None], cache_layer)
 
 
-def build_prefill(config: ModelConfig, mesh: Mesh):
+def _write_scale_blocks(scale_layer: jax.Array, update: jax.Array,
+                        slot: jax.Array, start_blk: int = 0) -> jax.Array:
+    """``_write_prompt_blocks`` for the int8 side-channel scale plane:
+    scale_layer ``[B, nb, kvh]``, update ``[wb, kvh]`` — same one-hot
+    slot mask + static block mask, so the scale write is exactly as
+    shard-local as the block write it accompanies."""
+    b_dim, nb = scale_layer.shape[:2]
+    wb = update.shape[0]
+    padded = jnp.pad(update, ((start_blk, nb - start_blk - wb), (0, 0)))
+    slot_mask = (jnp.arange(b_dim) == slot)[:, None, None]
+    blk = jnp.arange(nb)
+    blk_mask = ((blk >= start_blk)
+                & (blk < start_blk + wb))[None, :, None]
+    return jnp.where(slot_mask & blk_mask, padded[None], scale_layer)
+
+
+def build_prefill(config: ModelConfig, mesh: Mesh,
+                  quantized: bool = False):
     """Jitted ``prefill(cache, params, x, slot, length) -> (cache,
     y_last)`` — retraces once per prompt bucket (x's static shape).  The
     cache is donated (argnum 0), so the carried protocol matches the
-    train-step convention the audit and calibration understand."""
+    train-step convention the audit and calibration understand.
+
+    ``quantized`` writes the int8 layout (``QuantKVCache``): each
+    freshly-computed K/V block is quantised per (block, kv-head) and
+    the fp32 scales land in the side-channel plane via
+    ``_write_scale_blocks``.  Prefill attention runs over the chunk's
+    own fp K/V (it never reads the cache), so quantisation touches
+    only the write."""
     n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
 
-    def prefill(cache: KVCache, params, x, slot, length):
+    def prefill(cache, params, x, slot, length):
         bs = cache.block_size
         s_bucket = x.shape[1]
         wb = s_bucket // bs
 
         def attention_step(q, k, v, cache_state):
-            k_l, v_l = cache_state
+            if quantized:
+                k_l, v_l, ks_l, vs_l = cache_state
+            else:
+                k_l, v_l = cache_state
             qh, kh, vh = (_heads(q, n, d), _heads(k, kvh, d),
                           _heads(v, kvh, d))
             attn = dense_attention(qh, kh, vh, causal=config.causal)
@@ -669,18 +774,30 @@ def build_prefill(config: ModelConfig, mesh: Mesh):
             # token-major, re-tiled to whole blocks)
             k_blocks = kh.transpose(0, 2, 1, 3)[0].reshape(wb, bs, kvh, d)
             v_blocks = vh.transpose(0, 2, 1, 3)[0].reshape(wb, bs, kvh, d)
-            k_l = _write_prompt_blocks(k_l, k_blocks, slot)
-            v_l = _write_prompt_blocks(v_l, v_blocks, slot)
+            if quantized:
+                kq, ks = quantize_kv_blocks(k_blocks)
+                vq, vs = quantize_kv_blocks(v_blocks)
+                k_l = _write_prompt_blocks(k_l, kq, slot)
+                v_l = _write_prompt_blocks(v_l, vq, slot)
+                ks_l = _write_scale_blocks(ks_l, ks, slot)
+                vs_l = _write_scale_blocks(vs_l, vs, slot)
+                state = (k_l, v_l, ks_l, vs_l)
+            else:
+                k_l = _write_prompt_blocks(k_l, k_blocks, slot)
+                v_l = _write_prompt_blocks(v_l, v_blocks, slot)
+                state = (k_l, v_l)
             return (attn.transpose(0, 2, 1, 3).reshape(1, s_bucket, n * d),
-                    (k_l, v_l))
+                    state)
 
         def body(h, layer_and_cache):
-            layer, k_l, v_l = layer_and_cache
+            layer, *cache_state = layer_and_cache
             return _serve_block(h, layer, config, attention_step,
-                                (k_l, v_l))
+                                tuple(cache_state))
 
-        h, (k_new, v_new) = jax.lax.scan(
-            body, x, (params["layers"], cache.k, cache.v)
+        planes = ((cache.k, cache.v, cache.k_scale, cache.v_scale)
+                  if quantized else (cache.k, cache.v))
+        h, new_planes = jax.lax.scan(
+            body, x, (params["layers"], *planes)
         )
         y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
         y_last = jax.lax.dynamic_slice(
@@ -688,12 +805,15 @@ def build_prefill(config: ModelConfig, mesh: Mesh):
         )[0, 0]
         lengths = jnp.where(jnp.arange(cache.max_batch) == slot,
                             length, cache.lengths).astype(jnp.int32)
-        return KVCache(k_new, v_new, lengths), y_last
+        cache_cls = QuantKVCache if quantized else KVCache
+        return cache_cls(*new_planes, lengths), y_last
 
+    cache_sh = (quant_cache_shardings(mesh) if quantized
+                else cache_shardings(mesh))
     return jax.jit(
         prefill,
         donate_argnums=(0,),
-        out_shardings=(cache_shardings(mesh), NamedSharding(mesh, P())),
+        out_shardings=(cache_sh, NamedSharding(mesh, P())),
     )
 
 
@@ -753,7 +873,7 @@ def _chunk_attention(qh: jax.Array, k_all: jax.Array, v_all: jax.Array,
 
 
 def build_prefill_chunk(config: ModelConfig, mesh: Mesh, chunk_len: int,
-                        start: int):
+                        start: int, quantized: bool = False):
     """Jitted ``prefill_chunk(cache, prefix, params, x, slot, length) ->
     (cache, prefix, y_last)`` — one chunk of a chunked prefill at STATIC
     global offset ``start`` (a block multiple; one retrace per chunk
@@ -768,7 +888,12 @@ def build_prefill_chunk(config: ModelConfig, mesh: Mesh, chunk_len: int,
     length; ``y_last`` is the output at the last real position when it
     falls inside this chunk (the engine uses only the final chunk's).
     Only the cache is donated (the returned prefix is larger than the
-    input one, so its buffers can never alias)."""
+    input one, so its buffers can never alias).
+
+    ``quantized`` writes the chunk's blocks in the int8 layout (scales
+    into the side-channel plane); the carried prefix K/V stays fp —
+    attention always runs over exact chunk values, so quantisation
+    touches only the cache write, exactly as in monolithic prefill."""
     n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
 
     def prefill_chunk(cache, prefix, params, x, slot, length):
@@ -777,30 +902,50 @@ def build_prefill_chunk(config: ModelConfig, mesh: Mesh, chunk_len: int,
         start_blk = start // bs
 
         def attention_step(q, k, v, cache_state):
-            k_l, v_l, pk_l, pv_l = cache_state
+            if quantized:
+                k_l, v_l, ks_l, vs_l, pk_l, pv_l = cache_state
+            else:
+                k_l, v_l, pk_l, pv_l = cache_state
             qh = _heads(q, n, d)                        # [1, n, C, d]
             k_chunk = k[0].reshape(chunk_len, kvh, d)
             v_chunk = v[0].reshape(chunk_len, kvh, d)
             k_all = jnp.concatenate([pk_l, k_chunk], axis=0)
             v_all = jnp.concatenate([pv_l, v_chunk], axis=0)
             attn = _chunk_attention(qh, k_all, v_all, start)
-            k_l = _write_prompt_blocks(
-                k_l, k_chunk.reshape(wb, bs, kvh, d), slot, start_blk)
-            v_l = _write_prompt_blocks(
-                v_l, v_chunk.reshape(wb, bs, kvh, d), slot, start_blk)
+            if quantized:
+                kq, ks = quantize_kv_blocks(
+                    k_chunk.reshape(wb, bs, kvh, d))
+                vq, vs = quantize_kv_blocks(
+                    v_chunk.reshape(wb, bs, kvh, d))
+                k_l = _write_prompt_blocks(k_l, kq, slot, start_blk)
+                v_l = _write_prompt_blocks(v_l, vq, slot, start_blk)
+                ks_l = _write_scale_blocks(ks_l, ks, slot, start_blk)
+                vs_l = _write_scale_blocks(vs_l, vs, slot, start_blk)
+                state = (k_l, v_l, ks_l, vs_l, k_all, v_all)
+            else:
+                k_l = _write_prompt_blocks(
+                    k_l, k_chunk.reshape(wb, bs, kvh, d), slot,
+                    start_blk)
+                v_l = _write_prompt_blocks(
+                    v_l, v_chunk.reshape(wb, bs, kvh, d), slot,
+                    start_blk)
+                state = (k_l, v_l, k_all, v_all)
             return (attn.transpose(0, 2, 1, 3).reshape(1, chunk_len,
                                                        n * d),
-                    (k_l, v_l, k_all, v_all))
+                    state)
 
         def body(h, layer_and_cache):
-            layer, k_l, v_l, pk_l, pv_l = layer_and_cache
+            layer, *cache_state = layer_and_cache
             return _serve_block(h, layer, config, attention_step,
-                                (k_l, v_l, pk_l, pv_l))
+                                tuple(cache_state))
 
         pk, pv = prefix
-        h, (k_new, v_new, pk_new, pv_new) = jax.lax.scan(
-            body, x, (params["layers"], cache.k, cache.v, pk, pv)
+        planes = ((cache.k, cache.v, cache.k_scale, cache.v_scale)
+                  if quantized else (cache.k, cache.v))
+        h, new_state = jax.lax.scan(
+            body, x, (params["layers"], *planes, pk, pv)
         )
+        new_planes, (pk_new, pv_new) = new_state[:-2], new_state[-2:]
         y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
         local = jnp.clip(length - 1 - start, 0, chunk_len - 1)
         y_last = jax.lax.dynamic_slice(
@@ -809,16 +954,82 @@ def build_prefill_chunk(config: ModelConfig, mesh: Mesh, chunk_len: int,
         new_len = jnp.minimum(length, start + chunk_len)
         lengths = jnp.where(jnp.arange(cache.max_batch) == slot,
                             new_len, cache.lengths).astype(jnp.int32)
-        return (KVCache(k_new, v_new, lengths), (pk_new, pv_new), y_last)
+        cache_cls = QuantKVCache if quantized else KVCache
+        return (cache_cls(*new_planes, lengths), (pk_new, pv_new), y_last)
 
     pre_sh = NamedSharding(mesh, prefix_spec(mesh))
+    cache_sh = (quant_cache_shardings(mesh) if quantized
+                else cache_shardings(mesh))
     # only the cache is donated: the returned prefix is LARGER than the
     # input one (start -> start + C), so its buffers can never alias
     return jax.jit(
         prefill_chunk,
         donate_argnums=(0,),
-        out_shardings=(cache_shardings(mesh), (pre_sh, pre_sh),
+        out_shardings=(cache_sh, (pre_sh, pre_sh),
                        NamedSharding(mesh, P())),
+    )
+
+
+def build_prefix_attach(config: ModelConfig, mesh: Mesh,
+                        matched_len: int, block_size: int,
+                        quantized: bool = False):
+    """Jitted ``attach(cache, src, dst) -> (cache, prefix)`` — the
+    copy-on-attach step of the shared-prefix cache (one retrace per
+    matched chunk count, like the bucketed chunk jits).
+
+    Copies the donor slot ``src``'s first ``matched_len/block_size``
+    blocks (every plane — K/V, and the scale side-channel in the int8
+    layout) into the admitted slot ``dst`` via the same one-hot masked
+    select as ``_write_prompt_blocks`` — pure elementwise on a dp=1
+    slot dim (``ServingConfig.validate`` pins prefix_caching to dp=1),
+    so the attach lowers to ZERO collectives (audited).  Also returns
+    the matched prefix as the fp chunk-prefill carry ``[L, matched_len,
+    kvh, d]``, exactly what the chunk jits would have produced for the
+    same token blocks (bit-identical in the fp layout — the cache
+    blocks ARE the chunk values; dequantised in the int8 layout), so
+    the suffix chunks resume at static offset ``matched_len`` with no
+    recompute.  The engine's scheduler replaces the matched chunks'
+    prefill dispatches with this single copy — that is the TTFT win."""
+    nb_m = matched_len // block_size
+    kvh, d = config.kv_heads, config.head_dim
+    dtype = _dtype_of(config.dtype)
+
+    def copy(plane, src, dst):
+        donor = jnp.take(plane, src, axis=1)     # slot dim dropped
+        slot_mask = (jnp.arange(plane.shape[1]) == dst).reshape(
+            (1, -1) + (1,) * (plane.ndim - 2))
+        blk_mask = (jnp.arange(plane.shape[2]) < nb_m).reshape(
+            (1, 1, -1) + (1,) * (plane.ndim - 3))
+        return jnp.where(slot_mask & blk_mask, donor[:, None], plane)
+
+    def attach(cache, src, dst):
+        nl = cache.k.shape[0]
+        k_q = jnp.take(cache.k, src, axis=1)[:, :nb_m]
+        v_q = jnp.take(cache.v, src, axis=1)[:, :nb_m]
+        if quantized:
+            ks = jnp.take(cache.k_scale, src, axis=1)[:, :nb_m]
+            vs = jnp.take(cache.v_scale, src, axis=1)[:, :nb_m]
+            pk = dequantize_kv_blocks(k_q, ks, dtype)
+            pv = dequantize_kv_blocks(v_q, vs, dtype)
+            new_cache = QuantKVCache(
+                copy(cache.k, src, dst), copy(cache.v, src, dst),
+                copy(cache.k_scale, src, dst),
+                copy(cache.v_scale, src, dst), cache.lengths)
+        else:
+            pk, pv = k_q, v_q
+            new_cache = KVCache(copy(cache.k, src, dst),
+                                copy(cache.v, src, dst), cache.lengths)
+        prefix = (pk.reshape(nl, matched_len, kvh, d),
+                  pv.reshape(nl, matched_len, kvh, d))
+        return new_cache, prefix
+
+    pre_sh = NamedSharding(mesh, prefix_spec(mesh))
+    cache_sh = (quant_cache_shardings(mesh) if quantized
+                else cache_shardings(mesh))
+    return jax.jit(
+        attach,
+        donate_argnums=(0,),
+        out_shardings=(cache_sh, (pre_sh, pre_sh)),
     )
 
 
@@ -871,10 +1082,22 @@ def decode_batch_spec(mesh: Mesh) -> P:
     return P(dp, None, None)
 
 
-def _decode_step_math(carry, params, active, config: ModelConfig):
+def _decode_step_math(carry, params, active, config: ModelConfig,
+                      quantized: bool = False):
     """The decode-step computation shared VERBATIM by the per-step jit
     and every trip of the fused scan (the equivalence contract between
-    the two engines is that this is the one copy of the math)."""
+    the two engines is that this is the one copy of the math).
+
+    ``quantized`` reads/writes the int8 layout: each layer's blocks are
+    dequantised to fp32 (exact — int8 times an fp32 scale), the token
+    appended in fp, attention length-masked as ever, and the layer
+    requantised with an active-slot select so an INACTIVE slot's int8/
+    scale planes pass through verbatim.  An active slot's untouched
+    blocks survive the dequant->requant round trip bit-stably: every
+    stored value is ``q*s`` with ``|q| <= 127``, the recomputed scale
+    differs from ``s`` only by fp32 rounding, so the re-rounded code is
+    the same ``q`` (error ~2^-22 * 127, far below the 0.5 rounding
+    threshold)."""
     n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
     cache, x = carry
     b_dim, s_max = cache.max_batch, cache.max_seq
@@ -883,40 +1106,64 @@ def _decode_step_math(carry, params, active, config: ModelConfig):
     pos = jnp.arange(s_max)[None, :]
     write_mask = (pos == lengths[:, None]) & active[:, None]
     valid = pos <= lengths[:, None]
+    sel5 = active[:, None, None, None, None]
+    sel3 = active[:, None, None]
 
     def attention_step(q, k, v, cache_state):
-        k_l, v_l = cache_state
+        if quantized:
+            k_l, v_l, ks_l, vs_l = cache_state
+            k_fp = dequantize_kv_blocks(k_l, ks_l, jnp.float32)
+            v_fp = dequantize_kv_blocks(v_l, vs_l, jnp.float32)
+        else:
+            k_l, v_l = cache_state
+            k_fp, v_fp = k_l, v_l
         qh = _heads(q, n, d)                        # [B, n, 1, d]
-        k_new = k[:, 0].reshape(b_dim, kvh, d)
-        v_new = v[:, 0].reshape(b_dim, kvh, d)
+        k_new = k[:, 0].reshape(b_dim, kvh, d).astype(k_fp.dtype)
+        v_new = v[:, 0].reshape(b_dim, kvh, d).astype(v_fp.dtype)
         # append at each active slot's own length (masked select —
         # elementwise, shard-local; see serve/kvcache.py)
-        k_flat = k_l.reshape(b_dim, s_max, kvh, d)
-        v_flat = v_l.reshape(b_dim, s_max, kvh, d)
+        k_flat = k_fp.reshape(b_dim, s_max, kvh, d)
+        v_flat = v_fp.reshape(b_dim, s_max, kvh, d)
         k_flat = jnp.where(write_mask[..., None, None],
                            k_new[:, None], k_flat)
         v_flat = jnp.where(write_mask[..., None, None],
                            v_new[:, None], v_flat)
-        attn = _cached_attention(qh, k_flat, v_flat, valid)
+        attn = _cached_attention(qh, k_flat.astype(x.dtype),
+                                 v_flat.astype(x.dtype), valid)
+        if quantized:
+            kq, ks = quantize_kv_blocks(
+                k_flat.reshape(b_dim, nb, bs, kvh, d))
+            vq, vs = quantize_kv_blocks(
+                v_flat.reshape(b_dim, nb, bs, kvh, d))
+            state = (jnp.where(sel5, kq, k_l),
+                     jnp.where(sel5, vq, v_l),
+                     jnp.where(sel3, ks, ks_l),
+                     jnp.where(sel3, vs, vs_l))
+        else:
+            state = (k_flat.reshape(b_dim, nb, bs, kvh, d),
+                     v_flat.reshape(b_dim, nb, bs, kvh, d))
         return (attn.transpose(0, 2, 1, 3).reshape(b_dim, 1, n * d),
-                (k_flat.reshape(b_dim, nb, bs, kvh, d),
-                 v_flat.reshape(b_dim, nb, bs, kvh, d)))
+                state)
 
     def body(h, layer_and_cache):
-        layer, k_l, v_l = layer_and_cache
+        layer, *cache_state = layer_and_cache
         return _serve_block(h, layer, config, attention_step,
-                            (k_l, v_l))
+                            tuple(cache_state))
 
-    h, (k_new, v_new) = jax.lax.scan(
-        body, x, (params["layers"], cache.k, cache.v)
+    planes = ((cache.k, cache.v, cache.k_scale, cache.v_scale)
+              if quantized else (cache.k, cache.v))
+    h, new_planes = jax.lax.scan(
+        body, x, (params["layers"], *planes)
     )
     y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
     lengths = lengths + active.astype(jnp.int32)
-    new_cache = KVCache(k_new, v_new, lengths)
+    cache_cls = QuantKVCache if quantized else KVCache
+    new_cache = cache_cls(*new_planes, lengths)
     return (new_cache, y), y
 
 
-def build_decode_step(config: ModelConfig, mesh: Mesh):
+def build_decode_step(config: ModelConfig, mesh: Mesh,
+                      quantized: bool = False):
     """Jitted ``decode_step(carry, params, active) -> (carry, y)`` with
     ``carry = (cache, x)`` — ONE fixed-shape compile for the whole run.
     The carry is donated; its returned ``x`` is this step's output, so
@@ -924,17 +1171,21 @@ def build_decode_step(config: ModelConfig, mesh: Mesh):
     ``out[0]`` straight back in."""
 
     def decode_step(carry, params, active):
-        return _decode_step_math(carry, params, active, config)
+        return _decode_step_math(carry, params, active, config,
+                                 quantized=quantized)
 
     x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    cache_sh = (quant_cache_shardings(mesh) if quantized
+                else cache_shardings(mesh))
     return jax.jit(
         decode_step,
         donate_argnums=(0,),
-        out_shardings=((cache_shardings(mesh), x_sh), x_sh),
+        out_shardings=((cache_sh, x_sh), x_sh),
     )
 
 
-def build_decode_fused(config: ModelConfig, mesh: Mesh, k: int):
+def build_decode_fused(config: ModelConfig, mesh: Mesh, k: int,
+                       quantized: bool = False):
     """Jitted ``decode_fused(carry, params, active, remaining) ->
     (carry, ys)`` — ``k`` decode steps fused into ONE ``lax.scan``
     dispatch over the donated ``(cache, x)`` carry (static ``k``; the
@@ -948,6 +1199,7 @@ def build_decode_fused(config: ModelConfig, mesh: Mesh, k: int):
     it, and the ledger frees its blocks at scan exit.  ``ys`` stacks
     every step's output ``[k, max_batch, 1, H]`` (step t's row is the
     token each then-active slot generated at trip t)."""
+    cache_cls = QuantKVCache if quantized else KVCache
 
     def decode_fused(carry, params, active, remaining):
         # the slot-lengths vector deliberately stays OUT of the scan
@@ -959,32 +1211,37 @@ def build_decode_fused(config: ModelConfig, mesh: Mesh, k: int):
         # it and re-gathers at the loop boundary — a (tiny, but
         # contract-breaking) collective the decode kind-set forbids.
         # The trip index rides the carry as a scalar for the same
-        # reason (an arange-xs array invites an iota reshard).
+        # reason (an arange-xs array invites an iota reshard).  The
+        # cache's data planes ride positionally (``cache[:-1]`` — K/V,
+        # plus the int8 scale planes when quantized), lengths excluded.
         cache0, x0 = carry
         lengths0 = cache0.lengths
         act_i32 = active.astype(jnp.int32)
 
         def step(c, _):
-            k_c, v_c, x, i = c
+            *planes, x, i = c
             step_active = active & (i < remaining)
             lengths_i = lengths0 + act_i32 * jnp.minimum(i, remaining)
             (cache, x2), y = _decode_step_math(
-                (KVCache(k_c, v_c, lengths_i), x), params, step_active,
-                config)
-            return (cache.k, cache.v, x2, i + 1), y
+                (cache_cls(*planes, lengths_i), x), params, step_active,
+                config, quantized=quantized)
+            return (*cache[:-1], x2, i + 1), y
 
-        (k_c, v_c, x, _i), ys = jax.lax.scan(
-            step, (cache0.k, cache0.v, x0, jnp.int32(0)), None, length=k)
+        final, ys = jax.lax.scan(
+            step, (*cache0[:-1], x0, jnp.int32(0)), None, length=k)
+        *planes, x, _i = final
         lengths_f = lengths0 + act_i32 * jnp.minimum(jnp.int32(k),
                                                      remaining)
-        return (KVCache(k_c, v_c, lengths_f), x), ys
+        return (cache_cls(*planes, lengths_f), x), ys
 
     x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
     ys_sh = NamedSharding(mesh, P(None, *decode_batch_spec(mesh)))
+    cache_sh = (quant_cache_shardings(mesh) if quantized
+                else cache_shardings(mesh))
     return jax.jit(
         decode_fused,
         donate_argnums=(0,),
-        out_shardings=((cache_shardings(mesh), x_sh), ys_sh),
+        out_shardings=((cache_sh, x_sh), ys_sh),
     )
 
 
@@ -1400,6 +1657,10 @@ class _RunStats:
     spec_commit_tokens: int = 0     # committed incl. the bonus token
     spec_slot_verifies: int = 0     # slot-level verifies (for mean len)
     spec_draft_s: float = 0.0       # host drafting / draft-scan wall
+    # shared-prefix cache (docs/serving.md, "Prefix cache & quantized KV")
+    prefix_hits: int = 0            # admissions that attached to the trie
+    prefix_tokens_reused: int = 0   # prompt tokens served from shared blocks
+    prefix_cow_blocks: int = 0      # blocks rewritten privately (CoW)
 
 
 class ServingEngine:
@@ -1468,17 +1729,32 @@ class ServingEngine:
              "decode units abandoned by the dispatch watchdog"),
         ):
             self.registry.inc(name, 0, help=hlp)
+        self._quantized = serving.kv_quantization == "int8"
+        if serving.prefix_caching:
+            for name, hlp in (
+                ("serve_prefix_hits",
+                 "admissions that attached to shared prefix blocks"),
+                ("serve_prefix_tokens_reused",
+                 "prompt tokens served from shared blocks (prefill "
+                 "skipped)"),
+            ):
+                self.registry.inc(name, 0, help=hlp)
         self._dtype = _dtype_of(config.dtype)
         self.params = (params if params is not None
                        else init_params_sharded(config, jax.random.key(seed),
                                                 mesh))
-        self._prefill = build_prefill(config, mesh)
-        self._decode = build_decode_step(config, mesh)
+        self._prefill = build_prefill(config, mesh,
+                                      quantized=self._quantized)
+        self._decode = build_decode_step(config, mesh,
+                                         quantized=self._quantized)
         self._fused_ks = serving.fused_horizons
         self._decode_fused = {
-            k: build_decode_fused(config, mesh, k) for k in self._fused_ks
+            k: build_decode_fused(config, mesh, k,
+                                  quantized=self._quantized)
+            for k in self._fused_ks
         }
         self._prefill_chunk_jits: dict[int, Any] = {}
+        self._attach_jits: dict[int, Any] = {}
         self._compact_gather_fn = None
         self._compact_scatter_fn = None
         if serving.compact_threshold is not None:
@@ -1557,8 +1833,10 @@ class ServingEngine:
 
     # -- setup -------------------------------------------------------------
 
-    def _fresh_carry(self) -> tuple[KVCache, jax.Array]:
-        cache = create_kv_cache(
+    def _fresh_carry(self):
+        create = (create_quant_kv_cache if self._quantized
+                  else create_kv_cache)
+        cache = create(
             self.config, self.serving.max_batch, self.serving.num_blocks,
             self.serving.block_size, mesh=self.mesh,
         )
@@ -1704,8 +1982,23 @@ class ServingEngine:
         if jit is None:
             chunk = self.serving.prefill_chunk
             jit = build_prefill_chunk(self.config, self.mesh, chunk,
-                                      chunk_index * chunk)
+                                      chunk_index * chunk,
+                                      quantized=self._quantized)
             self._prefill_chunk_jits[chunk_index] = jit
+        return jit
+
+    def _attach_jit(self, m_chunks: int):
+        """The prefix-attach jit for ``m_chunks`` matched chunks (one
+        retrace per matched chunk count — the same bucketing as the
+        chunk-jit ladder; built lazily, warmed by ``_compile``)."""
+        jit = self._attach_jits.get(m_chunks)
+        if jit is None:
+            chunk = self.serving.prefill_chunk
+            jit = build_prefix_attach(self.config, self.mesh,
+                                      m_chunks * chunk,
+                                      self.serving.block_size,
+                                      quantized=self._quantized)
+            self._attach_jits[m_chunks] = jit
         return jit
 
     def _compile(self, buckets: list[int], max_chunks: int = 0) -> None:
@@ -1736,6 +2029,13 @@ class ServingEngine:
                     cache, prefix, self.params,
                     dummy[:, ci * chunk:(ci + 1) * chunk],
                     np.int32(0), np.int32(total))
+            if cfg.prefix_caching:
+                # the attach ladder: one jit per possible matched chunk
+                # count (a full prompt always keeps >= 1 unmatched
+                # chunk, so the ladder stops at max_chunks - 1)
+                for m in range(1, max_chunks):
+                    cache, _prefix = self._attach_jit(m)(
+                        cache, np.int32(0), np.int32(0))
             carry = (cache, carry[1])
         remaining = jax.device_put(
             jnp.zeros((cfg.max_batch,), jnp.int32), self._active_sharding)
@@ -1854,7 +2154,8 @@ class ServingEngine:
             self._compile(buckets, max_chunks)
         compile_time = t_compile.elapsed
 
-        ledger = BlockLedger(cfg.total_blocks, cfg.block_size)
+        ledger = BlockLedger(cfg.total_blocks, cfg.block_size,
+                             prefix_caching=cfg.prefix_caching)
         # registry counters are cumulative across an engine's lifetime
         # (Prometheus semantics); the report carries THIS run's deltas
         counts_base = {k: self._requests[k] for k in self._requests}
@@ -1868,6 +2169,8 @@ class ServingEngine:
             "t_s": [], "queue_depth": [], "active_slots": [],
             "blocks_in_use": [], "blocks_reserved": [],
         }
+        if cfg.prefix_caching:
+            series["shared_blocks"] = []
         carry = self._fresh_carry()
         active_np = np.zeros((cfg.max_batch,), bool)
         active_dev = jax.device_put(jnp.asarray(active_np),
@@ -2664,12 +2967,57 @@ class ServingEngine:
                     carry_resets[0] += 1
                     return
 
-        def prefill_once(req: Request, slot: int):
+        def attach_plan(req: Request) -> Optional[dict[str, Any]]:
+            """Host-side prefix match for one admission: the prompt's
+            full-block token-id chain (pure numpy, the same
+            admission-time id view the n-gram drafter uses — the trie
+            never touches the device), the trie's longest indexed
+            match, and the chunk-floored attach point.  The attach is
+            capped at whole CHUNKS (the suffix prefill resumes at a
+            static chunk-jit offset) and always leaves >= 1 chunk to
+            compute (the final chunk owns ``y_last`` and the slot
+            length); blocks the trie matched past that cap are
+            recomputed privately — the copy-on-write tail, counted via
+            ``note_cow``.  ``resets`` pins the carry generation: an
+            attach copies DEVICE blocks, so a plan from before a carry
+            reset degrades to a full prefill (the slot then physically
+            holds every block it refs, keeping the trie true)."""
+            bs = cfg.block_size
+            chunk = cfg.prefill_chunk
+            full_blocks = req.prompt_len // bs
+            plan = {"chain": [], "attach_blocks": 0, "attach_tokens": 0,
+                    "donor": None, "cow_blocks": 0,
+                    "resets": carry_resets[0], "attached_tokens": 0}
+            if full_blocks == 0:
+                return plan
+            ids = prompt_token_ids(
+                req.seed, req.prompt_len, self.config.hidden_size,
+                prefix_len=req.prefix_len, prefix_seed=req.prefix_seed)
+            chain = [tuple(ids[i * bs:(i + 1) * bs])
+                     for i in range(full_blocks)]
+            plan["chain"] = chain
+            depth, donor = ledger.match_prefix(chain)
+            cap = ((req.prompt_len - 1) // chunk) * chunk
+            attach_tokens = min(depth * bs, cap) // chunk * chunk
+            if donor is None or attach_tokens <= 0:
+                return plan
+            plan.update(attach_blocks=attach_tokens // bs,
+                        attach_tokens=attach_tokens, donor=donor,
+                        cow_blocks=depth - attach_tokens // bs)
+            return plan
+
+        def prefill_once(req: Request, slot: int,
+                         plan: Optional[dict[str, Any]] = None):
             """The prefill dispatch for one admitted request (chunked or
             monolithic) — returns ``(bucket, y_last, dt)``.  Raised
             through by the retry wrapper below; idempotent on retry:
             chunk writes are deterministic masked selects of identical
-            values, and interleaved decode units commit independently."""
+            values, and interleaved decode units commit independently.
+            With a prefix-attach ``plan``, the matched chunks' prefills
+            are replaced by ONE donor-block copy (``build_prefix_attach``)
+            and only the suffix chunks run; a carry reset since planning
+            degrades to the full prefill (a retry after a reset finds
+            zeroed donor blocks, so copying would serve garbage)."""
             nonlocal carry
             if inject.fire("serve-prefill-fail"):
                 # fires BEFORE any jit is invoked — see serve-decode-fail
@@ -2680,19 +3028,41 @@ class ServingEngine:
                 chunk = cfg.prefill_chunk
                 n_chunks = -(-req.prompt_len // chunk)
                 bucket = n_chunks * chunk
+                m_chunks = 0
+                if plan is not None and plan["attach_blocks"]:
+                    plan["attached_tokens"] = 0
+                    if carry_resets[0] == plan["resets"]:
+                        m_chunks = plan["attach_tokens"] // chunk
                 x_prompt = request_embeddings(
                     req.seed, req.prompt_len,
                     self.config.hidden_size,
                     dtype=self._dtype, pad_to=bucket,
+                    prefix_len=req.prefix_len,
+                    prefix_seed=req.prefix_seed,
                 )
                 with spans.span("serve-prefill", rid=req.rid,
                                 bucket=bucket, slot=slot,
-                                chunks=n_chunks):
+                                chunks=n_chunks - m_chunks):
                     t0 = time.perf_counter()
                     decode_spent = 0.0
-                    prefix = create_prefix(self.config, self.mesh)
                     cache = carry[0]
-                    for ci in range(n_chunks):
+                    if m_chunks:
+                        # copy-on-attach: one masked-select copy of the
+                        # donor's matched blocks stands in for the
+                        # matched chunks' prefill dispatches (the TTFT
+                        # win), and its returned fp prefix carry is
+                        # exactly what those chunks would have produced
+                        with spans.span("serve-prefix-attach",
+                                        rid=req.rid, slot=slot,
+                                        donor=plan["donor"],
+                                        blocks=plan["attach_blocks"]):
+                            cache, prefix = self._attach_jit(m_chunks)(
+                                cache, np.int32(plan["donor"]),
+                                np.int32(slot))
+                        plan["attached_tokens"] = m_chunks * chunk
+                    else:
+                        prefix = create_prefix(self.config, self.mesh)
+                    for ci in range(m_chunks, n_chunks):
                         with spans.span("serve-prefill-chunk",
                                         rid=req.rid, chunk=ci):
                             cache, prefix, y_last = \
@@ -2760,17 +3130,21 @@ class ServingEngine:
                 carry = (cache, carry[1])
             return bucket, y_last, dt
 
-        def prefill_dispatch(req: Request, slot: int):
+        def prefill_dispatch(req: Request, slot: int,
+                             plan: Optional[dict[str, Any]] = None):
             """Bounded-retry wrapper around :func:`prefill_once` —
             transient dispatch failures back off and re-issue (chunk
             counters rolled back so a retried prefill never
             double-counts); exhaustion raises to the admission loop's
-            fail-closed path."""
+            fail-closed path.  The prefix-attach ``plan`` rides through
+            unchanged: each attempt re-checks the carry generation
+            itself, so a retry after a mid-prefill carry reset degrades
+            to the full prefill instead of copying zeroed donor blocks."""
             attempt = 0
             while True:
                 chunks_base = stats.prefill_chunks
                 try:
-                    return prefill_once(req, slot)
+                    return prefill_once(req, slot, plan)
                 except (TransientFault, CorruptStats) as e:
                     stats.prefill_chunks = chunks_base
                     if attempt >= cfg.max_dispatch_retries:
@@ -2899,16 +3273,31 @@ class ServingEngine:
                 drain()
                 with spans.span("serve-admission", queue=len(queue),
                                 free_slots=len(free_slots)):
-                    while (queue and free_slots
-                            and ledger.can_reserve(queue[0].total_tokens)):
+                    while queue and free_slots:
+                        # prefix admission: blocks the trie already
+                        # holds are counted ONCE fleet-wide, so a
+                        # request whose private suffix fits is
+                        # admittable even when its full footprint
+                        # would not be — the int8/prefix capacity win
+                        plan = (attach_plan(queue[0])
+                                if cfg.prefix_caching else None)
+                        attach_blocks = (plan["attach_blocks"]
+                                         if plan else 0)
+                        if not ledger.can_reserve(
+                                queue[0].total_tokens,
+                                shared_blocks=attach_blocks):
+                            break
                         req = queue.popleft()
                         slot = free_slots.pop(0)
-                        ledger.reserve(slot, req.total_tokens)
+                        ledger.reserve(
+                            slot, req.total_tokens,
+                            chain=(plan["chain"] if plan else None),
+                            attach_blocks=attach_blocks)
                         if draft_ledger is not None:
                             draft_ledger.reserve(slot, req.total_tokens)
                         try:
-                            bucket, y_last, dt = prefill_dispatch(req,
-                                                                  slot)
+                            bucket, y_last, dt = prefill_dispatch(
+                                req, slot, plan)
                         except Exception as e:  # noqa: BLE001 — closed
                             fail_admission(req, slot, e)
                             continue
@@ -2927,6 +3316,34 @@ class ServingEngine:
                         ledger.append(slot, req.prompt_len)
                         if draft_ledger is not None:
                             draft_ledger.append(slot, req.prompt_len)
+                        if cfg.prefix_caching and plan is not None:
+                            reused = plan["attached_tokens"]
+                            if reused:
+                                stats.prefix_hits += 1
+                                stats.prefix_tokens_reused += reused
+                                self.registry.inc("serve_prefix_hits")
+                                self.registry.inc(
+                                    "serve_prefix_tokens_reused", reused)
+                                self._event(
+                                    "prefix-attach", req.rid, slot=slot,
+                                    donor=plan["donor"], tokens=reused,
+                                    blocks=reused // cfg.block_size)
+                                if plan["cow_blocks"]:
+                                    # matched deeper than the attach cap:
+                                    # the tail blocks were recomputed
+                                    # privately — the copy-on-write edge
+                                    ledger.note_cow(plan["cow_blocks"])
+                                    stats.prefix_cow_blocks += (
+                                        plan["cow_blocks"])
+                                    self._event(
+                                        "prefix-cow", req.rid, slot=slot,
+                                        blocks=plan["cow_blocks"])
+                            # index this slot's full-block chain: the
+                            # prefill (attached or full) made the slot
+                            # a physical holder of every block it refs,
+                            # and dedup against already-shared blocks
+                            # refunds the private reservation
+                            ledger.register(slot, plan["chain"])
                         t_first = self._now()
                         st = _SlotState(req=req, tokens_done=1,
                                         admitted_s=now,
@@ -2939,7 +3356,9 @@ class ServingEngine:
                             hist[req.rid] = prompt_token_ids(
                                 req.seed, req.prompt_len,
                                 self.config.hidden_size,
-                                period=req.prompt_period) + [first_id]
+                                period=req.prompt_period,
+                                prefix_len=req.prefix_len,
+                                prefix_seed=req.prefix_seed) + [first_id]
                         slots[slot] = st
                         active_np[slot] = True
                         active_dirty[0] = True
@@ -2989,6 +3408,14 @@ class ServingEngine:
             self.registry.set_gauge("serve_cache_blocks_in_use",
                                     ledger.blocks_in_use,
                                     help="cache blocks holding tokens")
+            if cfg.prefix_caching:
+                series["shared_blocks"].append(ledger.shared_blocks)
+                self.registry.set_gauge(
+                    "serve_cache_shared_blocks", ledger.shared_blocks,
+                    help="trie-indexed blocks counted once fleet-wide")
+                self.registry.set_gauge(
+                    "serve_cache_prefix_refs", ledger.trie.total_refs(),
+                    help="slot references across all shared blocks")
         drain()
         remaining_rids: list[int] = []
         if preempted:
@@ -3106,6 +3533,15 @@ class ServingEngine:
             },
             "preempted": preempted,
             "remaining_rids": sorted(remaining_rids),
+            "prefix": {
+                "enabled": cfg.prefix_caching,
+                "kv_quantization": cfg.kv_quantization,
+                "hits": stats.prefix_hits,
+                "tokens_reused": stats.prefix_tokens_reused,
+                "cow_blocks": stats.prefix_cow_blocks,
+                "hit_rate": (stats.prefix_hits / len(stats.prefill_s)
+                             if stats.prefill_s else 0.0),
+            },
             "ttft": summarize(stats.ttft_s),
             "per_token_latency": summarize(stats.per_token_s),
             "e2e_latency": summarize(stats.e2e_latency_s),

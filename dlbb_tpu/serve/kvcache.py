@@ -29,6 +29,31 @@ never OOM the cache mid-run (the build-time HBM gate is
 holding tokens (the occupancy the report plots), and completion frees
 both.  The ledger raising on over-use is a *bug* invariant, not a load
 condition — reservation-based admission makes it unreachable.
+
+Two capacity levers layer on top (``docs/serving.md``, "Prefix cache &
+quantized KV"):
+
+- **Shared-prefix blocks** (``serving.prefix_caching``): full prompt
+  blocks are content-addressed by their token-id chain in a host-side
+  :class:`PrefixTrie` inside the ledger.  A trie node is one *logical*
+  block, charged ONCE against the pool no matter how many resident
+  slots hold a physical copy; its refcount is the set of those slots,
+  so a block is only returned to the pool when the last reader frees
+  (`free` can never tear a live reader).  A request whose prompt
+  matches an indexed chain attaches to the shared blocks and prefills
+  only the suffix; the blocks past the attach point that the trie also
+  matched are rewritten privately — the copy-on-write on first
+  divergent append, counted in ``cow_blocks``.  Trie + refcounts
+  snapshot/restore WITH the ledger, so a dispatch rollback can never
+  double-free or leak a shared block.
+- **int8 KV planes** (``serving.kv_quantization="int8"``):
+  :class:`QuantKVCache` stores K/V as int8 blocks plus per-block
+  per-kv-head fp32 scales as a side-channel plane (the symmetric-amax
+  codec of ``comm/compression.py``), quartering the cache bytes the
+  HBM admission gate prices — ``models.configs.
+  kv_cache_bytes_per_device`` knows the layout, and the static memory
+  audit's ``serving-cache-drift`` rule pins it to the compiled decode
+  carry.
 """
 
 from __future__ import annotations
@@ -140,9 +165,242 @@ def scatter_cache_slots(cache: KVCache, small: KVCache,
     )
 
 
+# ---------------------------------------------------------------------------
+# int8-quantized cache plane (serving.kv_quantization="int8")
+# ---------------------------------------------------------------------------
+
+KV_QMAX = 127.0  # symmetric int8, same codec as comm/compression.py
+
+
+class QuantKVCache(NamedTuple):
+    """The int8 variant of :class:`KVCache`: K/V blocks stored as int8
+    with per-block per-kv-head fp32 scales as a side-channel plane.
+    Scales shard exactly like the data they scale (slot dim over dp,
+    kv-head dim over tp), so dequantisation inside the decode step is an
+    elementwise broadcast — shard-local, zero collectives."""
+
+    k: jax.Array         # int8 [L, max_batch, num_blocks, block_size, kvh, d]
+    v: jax.Array         # same
+    k_scale: jax.Array   # f32  [L, max_batch, num_blocks, kvh]
+    v_scale: jax.Array   # same
+    lengths: jax.Array   # [max_batch] int32
+
+    @property
+    def max_batch(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[3]
+
+    @property
+    def max_seq(self) -> int:
+        return self.num_blocks * self.block_size
+
+
+def quant_cache_specs(mesh: Optional[Mesh]) -> QuantKVCache:
+    """PartitionSpecs for :class:`QuantKVCache`: data like
+    :func:`cache_specs`, scales dropping the in-block dims."""
+    axes = getattr(mesh, "axis_names", ()) if mesh is not None else ()
+    dp = "dp" if "dp" in axes and mesh.shape["dp"] > 1 else None
+    tp = "tp" if "tp" in axes and mesh.shape["tp"] > 1 else None
+    kv_spec = P(None, dp, None, None, tp, None)
+    sc_spec = P(None, dp, None, tp)
+    return QuantKVCache(k=kv_spec, v=kv_spec, k_scale=sc_spec,
+                        v_scale=sc_spec, lengths=P(None))
+
+
+def quant_cache_shardings(mesh: Mesh) -> QuantKVCache:
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), quant_cache_specs(mesh),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def create_quant_kv_cache(
+    config: ModelConfig,
+    max_batch: int,
+    num_blocks: int,
+    block_size: int,
+    mesh: Optional[Mesh] = None,
+) -> QuantKVCache:
+    """Zero-initialised int8 cache (scales start at 1.0 so an untouched
+    block dequantises to exact zeros), created directly sharded."""
+    shape = (config.num_layers, max_batch, num_blocks, block_size,
+             config.kv_heads, config.head_dim)
+    sc_shape = (config.num_layers, max_batch, num_blocks, config.kv_heads)
+
+    def build() -> QuantKVCache:
+        return QuantKVCache(
+            k=jnp.zeros(shape, jnp.int8),
+            v=jnp.zeros(shape, jnp.int8),
+            k_scale=jnp.ones(sc_shape, jnp.float32),
+            v_scale=jnp.ones(sc_shape, jnp.float32),
+            lengths=jnp.zeros((max_batch,), jnp.int32),
+        )
+
+    if mesh is None:
+        return build()
+    return jax.jit(build, out_shardings=quant_cache_shardings(mesh))()
+
+
+def quantize_kv_blocks(blocks: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 over paged K/V blocks ``[..., block_size, kvh,
+    head_dim]`` with one fp32 scale per (block, kv-head): ``scale =
+    amax / 127`` guarded to 1.0 on all-zero blocks (the
+    ``comm/compression.py`` idiom).  Returns ``(int8 blocks, f32 scales
+    [..., kvh])``.  The round-trip is bit-stable: requantising a
+    dequantised block reproduces the int8 codes exactly (|q·s/s − q| <
+    2⁻²²·127 ≪ 0.5), so rewriting a whole cache layer never drifts the
+    blocks that were not touched."""
+    a = jnp.max(jnp.abs(blocks.astype(jnp.float32)), axis=(-3, -1))
+    s = jnp.where(a > 0.0, a / KV_QMAX, 1.0)
+    q = jnp.clip(jnp.round(blocks.astype(jnp.float32) / s[..., None, :, None]),
+                 -KV_QMAX, KV_QMAX)
+    return q.astype(jnp.int8), s.astype(jnp.float32)
+
+
+def dequantize_kv_blocks(q: jax.Array, scales: jax.Array,
+                         dtype: jnp.dtype) -> jax.Array:
+    """Inverse of :func:`quantize_kv_blocks` (broadcast multiply —
+    elementwise, shard-local under the cache sharding contract)."""
+    return (q.astype(jnp.float32) * scales[..., None, :, None]).astype(dtype)
+
+
 class CacheOverflow(RuntimeError):
     """A slot used more blocks than were reserved for it — an engine bug
     (reservation-based admission makes this unreachable under load)."""
+
+
+class PrefixTrie:
+    """Host-side radix index over full-block token-id chains.
+
+    One node per *logical* full block, keyed by the tuple of token ids
+    it holds under its parent chain — content-addressing, so identical
+    prompts dedupe even across trace groups.  A node's refcount is the
+    set of slots physically holding that block content; a slot always
+    holds a contiguous prefix of its chain starting at the root, so the
+    refs at any matched node are valid donors for the WHOLE path above
+    it (child refs ⊆ parent refs), and a node with an empty refcount
+    has no live reader and is pruned.  Entirely host-side dict walking
+    — the device programs never see it (``host-transfer-in-loop``
+    stays clean)."""
+
+    def __init__(self) -> None:
+        # (parent_node, block token tuple) -> node id; root is node 0
+        self._children: dict[tuple[int, tuple[int, ...]], int] = {}
+        self._parent: dict[int, tuple[int, tuple[int, ...]]] = {}
+        self._refs: dict[int, set[int]] = {}        # node -> holder slots
+        self._slot_nodes: dict[int, list[int]] = {}  # slot -> chain nodes
+        self._next_id = 1
+
+    @property
+    def num_nodes(self) -> int:
+        """Logical shared blocks currently indexed (the pool charge)."""
+        return len(self._refs)
+
+    def total_refs(self) -> int:
+        return sum(len(r) for r in self._refs.values())
+
+    def shared_depth(self, slot: int) -> int:
+        return len(self._slot_nodes.get(slot, ()))
+
+    def match(self, chain: list[tuple[int, ...]]) -> tuple[int, Optional[int]]:
+        """Longest indexed prefix of ``chain``: returns ``(blocks
+        matched, donor slot)`` — the donor (lowest resident slot id, for
+        determinism) physically holds every matched block."""
+        node, depth, donors = 0, 0, None
+        for key in chain:
+            child = self._children.get((node, tuple(key)))
+            if child is None:
+                break
+            node, depth, donors = child, depth + 1, self._refs[child]
+        if depth == 0 or not donors:
+            return 0, None
+        return depth, min(donors)
+
+    def attach(self, slot: int, chain: list[tuple[int, ...]],
+               depth: int) -> None:
+        """Record ``slot`` as a resident holder of the first ``depth``
+        blocks of ``chain`` (which must already be indexed — callers
+        attach only what :meth:`match` returned)."""
+        if slot in self._slot_nodes:
+            raise CacheOverflow(f"slot {slot} already holds a chain")
+        node, nodes = 0, []
+        for key in chain[:depth]:
+            node = self._children[(node, tuple(key))]
+            self._refs[node].add(slot)
+            nodes.append(node)
+        self._slot_nodes[slot] = nodes
+
+    def extend(self, slot: int, chain: list[tuple[int, ...]]) -> tuple[int, int]:
+        """Index ``slot``'s full chain past what it already holds,
+        creating nodes as needed.  Returns ``(created, newly_ref)``:
+        ``created`` nodes are new logical pool blocks; ``newly_ref``
+        counts every block that moved from the slot's private
+        reservation into shared accounting (``created`` ⊆ it — an
+        existing node newly ref'd is a dedupe, freeing one block of
+        budget)."""
+        nodes = self._slot_nodes.setdefault(slot, [])
+        node = nodes[-1] if nodes else 0
+        created = newly = 0
+        for key in chain[len(nodes):]:
+            key = tuple(key)
+            child = self._children.get((node, key))
+            if child is None:
+                child = self._next_id
+                self._next_id += 1
+                self._children[(node, key)] = child
+                self._parent[child] = (node, key)
+                self._refs[child] = set()
+                created += 1
+            if slot not in self._refs[child]:
+                self._refs[child].add(slot)
+                newly += 1
+            nodes.append(child)
+            node = child
+        return created, newly
+
+    def release(self, slot: int) -> int:
+        """Drop ``slot``'s residency; prune (deepest-first) every node
+        no live slot still holds.  Returns the pruned count — the
+        logical blocks actually returned to the pool; blocks other
+        slots still read stay charged, so eviction never tears a live
+        reader."""
+        pruned = 0
+        for node in reversed(self._slot_nodes.pop(slot, [])):
+            refs = self._refs.get(node)
+            if refs is None:
+                continue
+            refs.discard(slot)
+            if not refs:
+                parent, key = self._parent.pop(node)
+                del self._children[(parent, key)]
+                del self._refs[node]
+                pruned += 1
+        return pruned
+
+    def snapshot(self) -> dict:
+        return {
+            "children": dict(self._children),
+            "parent": dict(self._parent),
+            "refs": {n: set(r) for n, r in self._refs.items()},
+            "slot_nodes": {s: list(n)
+                           for s, n in self._slot_nodes.items()},
+            "next_id": self._next_id,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self._children = dict(snap["children"])
+        self._parent = dict(snap["parent"])
+        self._refs = {n: set(r) for n, r in snap["refs"].items()}
+        self._slot_nodes = {s: list(n)
+                            for s, n in snap["slot_nodes"].items()}
+        self._next_id = snap["next_id"]
 
 
 class BlockLedger:
@@ -152,9 +410,17 @@ class BlockLedger:
     ``max_batch * num_blocks``; configurable lower to model cache
     pressure).  Reservation is all-or-nothing per request; ``append``
     moves a block from reserved to in-use when a token crosses a block
-    boundary; ``free`` returns everything."""
+    boundary; ``free`` returns everything.
 
-    def __init__(self, total_blocks: int, block_size: int) -> None:
+    With ``prefix_caching`` the ledger carries a :class:`PrefixTrie`:
+    every trie node is a logical block charged ONCE to the pool
+    (``blocks_reserved`` = private reservations + trie nodes), a slot's
+    private reservation shrinks by the blocks it shares, and ``free``
+    returns a shared block only when the trie prunes it (refcount hit
+    zero)."""
+
+    def __init__(self, total_blocks: int, block_size: int,
+                 prefix_caching: bool = False) -> None:
         if total_blocks < 1 or block_size < 1:
             raise ValueError(
                 f"ledger needs positive sizes (total_blocks="
@@ -162,46 +428,108 @@ class BlockLedger:
             )
         self.total_blocks = total_blocks
         self.block_size = block_size
-        self._reserved: dict[int, int] = {}   # slot -> blocks reserved
+        self._reserved: dict[int, int] = {}   # slot -> PRIVATE blocks
         self._tokens: dict[int, int] = {}     # slot -> tokens appended
+        self._shared: dict[int, int] = {}     # slot -> shared blocks held
+        self.trie: Optional[PrefixTrie] = (
+            PrefixTrie() if prefix_caching else None)
+        self.cow_blocks = 0   # copy-on-write rewrites (monotone)
         self.peak_reserved = 0
         self.peak_in_use = 0
+        self.peak_shared = 0
 
     def blocks_for(self, tokens: int) -> int:
         return max(1, math.ceil(tokens / self.block_size))
 
     @property
+    def shared_blocks(self) -> int:
+        """Logical blocks in the shared pool (one per trie node)."""
+        return self.trie.num_nodes if self.trie is not None else 0
+
+    @property
     def blocks_reserved(self) -> int:
-        return sum(self._reserved.values())
+        return sum(self._reserved.values()) + self.shared_blocks
 
     @property
     def blocks_in_use(self) -> int:
-        return sum(self.blocks_for(t) if t else 0
-                   for t in self._tokens.values())
+        private = sum(
+            max(0, self.blocks_for(t) - self._shared.get(s, 0)) if t else 0
+            for s, t in self._tokens.items())
+        return private + self.shared_blocks
 
     @property
     def blocks_free(self) -> int:
         return self.total_blocks - self.blocks_reserved
 
-    def can_reserve(self, total_tokens: int) -> bool:
-        return self.blocks_for(total_tokens) <= self.blocks_free
+    def can_reserve(self, total_tokens: int,
+                    shared_blocks: int = 0) -> bool:
+        need = max(0, self.blocks_for(total_tokens) - shared_blocks)
+        return need <= self.blocks_free
 
-    def reserve(self, slot: int, total_tokens: int) -> int:
-        """Reserve a request's worst-case blocks for ``slot``; returns the
-        count.  Raises when the slot is already occupied or the budget
-        cannot cover it (callers gate on :meth:`can_reserve`)."""
+    def match_prefix(self, chain: list[tuple[int, ...]]
+                     ) -> tuple[int, Optional[int]]:
+        """Longest indexed block-chain prefix → ``(blocks, donor slot)``
+        (``(0, None)`` when prefix caching is off or nothing matches)."""
+        if self.trie is None or not chain:
+            return 0, None
+        return self.trie.match(chain)
+
+    def reserve(self, slot: int, total_tokens: int,
+                chain: Optional[list[tuple[int, ...]]] = None,
+                attach_blocks: int = 0) -> int:
+        """Reserve a request's worst-case blocks for ``slot``; returns
+        the PRIVATE count.  With ``attach_blocks`` > 0 the slot also
+        becomes a refcounted holder of the first ``attach_blocks``
+        blocks of ``chain`` (already charged to the shared pool), so
+        only the remainder is drawn from the free budget.  Raises when
+        the slot is already occupied or the budget cannot cover it
+        (callers gate on :meth:`can_reserve`)."""
         if slot in self._reserved:
             raise CacheOverflow(f"slot {slot} already holds a reservation")
-        need = self.blocks_for(total_tokens)
+        if attach_blocks and self.trie is None:
+            raise CacheOverflow("attach requires prefix_caching")
+        need = max(0, self.blocks_for(total_tokens) - attach_blocks)
         if need > self.blocks_free:
             raise CacheOverflow(
                 f"cannot reserve {need} blocks for slot {slot}: only "
                 f"{self.blocks_free}/{self.total_blocks} free"
             )
+        if attach_blocks:
+            self.trie.attach(slot, chain, attach_blocks)
         self._reserved[slot] = need
         self._tokens[slot] = 0
+        self._shared[slot] = attach_blocks
         self.peak_reserved = max(self.peak_reserved, self.blocks_reserved)
+        self.peak_shared = max(self.peak_shared, self.shared_blocks)
         return need
+
+    def register(self, slot: int, chain: list[tuple[int, ...]]) -> int:
+        """Index ``slot``'s full prompt block-chain in the trie (after
+        its prefill completed, so the slot physically holds every
+        block).  Blocks newly shared move from the slot's private
+        reservation into the pool charge; an already-indexed block this
+        slot now also holds is a dedupe that *frees* budget.  Returns
+        the number of blocks that moved to shared accounting."""
+        if self.trie is None or not chain:
+            return 0
+        if slot not in self._reserved:
+            raise CacheOverflow(f"register of unreserved slot {slot}")
+        _, newly = self.trie.extend(slot, chain)
+        if newly > self._reserved[slot]:
+            raise CacheOverflow(
+                f"slot {slot} shared {newly} blocks beyond its private "
+                f"reservation of {self._reserved[slot]}"
+            )
+        self._reserved[slot] -= newly
+        self._shared[slot] = self._shared.get(slot, 0) + newly
+        self.peak_shared = max(self.peak_shared, self.shared_blocks)
+        return newly
+
+    def note_cow(self, blocks: int) -> None:
+        """Count copy-on-write block rewrites (the trie matched deeper
+        than the request could attach, so the divergent tail is
+        recomputed into private blocks).  Monotone, like the peaks."""
+        self.cow_blocks += blocks
 
     def append(self, slot: int, tokens: int = 1) -> None:
         """Account ``tokens`` written into ``slot`` (prefill passes the
@@ -209,30 +537,42 @@ class BlockLedger:
         if slot not in self._reserved:
             raise CacheOverflow(f"append to unreserved slot {slot}")
         self._tokens[slot] += tokens
-        if self.blocks_for(self._tokens[slot]) > self._reserved[slot]:
+        entitled = self._reserved[slot] + self._shared.get(slot, 0)
+        if self.blocks_for(self._tokens[slot]) > entitled:
             raise CacheOverflow(
                 f"slot {slot} outgrew its reservation "
                 f"({self._tokens[slot]} tokens > "
-                f"{self._reserved[slot]} blocks x {self.block_size})"
+                f"{entitled} blocks x {self.block_size})"
             )
         self.peak_in_use = max(self.peak_in_use, self.blocks_in_use)
 
     def free(self, slot: int) -> int:
-        """Release a slot's reservation; returns the blocks returned."""
+        """Release a slot's reservation; returns the blocks actually
+        returned to the pool: its private blocks plus every shared
+        block whose refcount dropped to zero (blocks other live slots
+        still read stay charged — no torn readers, no double-free)."""
         if slot not in self._reserved:
             raise CacheOverflow(f"free of unreserved slot {slot}")
         blocks = self._reserved.pop(slot)
         self._tokens.pop(slot)
+        self._shared.pop(slot, None)
+        if self.trie is not None:
+            blocks += self.trie.release(slot)
         return blocks
 
-    def snapshot(self) -> dict[str, dict[int, int]]:
+    def snapshot(self) -> dict:
         """Copy of the alloc/append accounting — the serving engine's
         pre-dispatch rollback point (``docs/resilience.md``): a failed
-        or torn decode unit restores this before re-issuing."""
+        or torn decode unit restores this before re-issuing.  Includes
+        the trie + refcounts, so a retry can never double-free or leak
+        a shared block."""
         return {"reserved": dict(self._reserved),
-                "tokens": dict(self._tokens)}
+                "tokens": dict(self._tokens),
+                "shared": dict(self._shared),
+                "trie": (self.trie.snapshot()
+                         if self.trie is not None else None)}
 
-    def restore(self, snap: dict[str, dict[int, int]]) -> None:
+    def restore(self, snap: dict) -> None:
         """Roll the accounting back to a :meth:`snapshot`.  The peak
         counters deliberately stay monotone (a rolled-back peak was
         still a real high-water mark of host bookkeeping)."""
@@ -240,6 +580,10 @@ class BlockLedger:
         self._reserved.update(snap["reserved"])
         self._tokens.clear()
         self._tokens.update(snap["tokens"])
+        self._shared.clear()
+        self._shared.update(snap.get("shared", {}))
+        if self.trie is not None and snap.get("trie") is not None:
+            self.trie.restore(snap["trie"])
 
     def stats(self) -> dict[str, int]:
         return {
@@ -248,4 +592,9 @@ class BlockLedger:
             "blocks_in_use": self.blocks_in_use,
             "peak_blocks_reserved": self.peak_reserved,
             "peak_blocks_in_use": self.peak_in_use,
+            "shared_blocks": self.shared_blocks,
+            "peak_shared_blocks": self.peak_shared,
+            "prefix_refs": (self.trie.total_refs()
+                            if self.trie is not None else 0),
+            "cow_blocks": self.cow_blocks,
         }

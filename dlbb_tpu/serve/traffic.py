@@ -58,7 +58,13 @@ class Request:
     seeded motif of that many positions (``data/synthetic.py``) — the
     repeating-structure variant that gives the n-gram drafter real
     lookup structure; None (the default) keeps the original fully
-    random prompts and the original serialisation."""
+    random prompts and the original serialisation.
+    ``prefix_len``/``prefix_seed`` mark the request a member of a
+    shared-prefix group (``generate_trace(prefix_groups=…)``): its
+    first ``prefix_len`` prompt positions are drawn from the GROUP seed
+    ``prefix_seed``, bit-identical across the group, which is what the
+    engine's prefix trie content-addresses.  Absent (None) keeps the
+    original prompts and serialisation."""
 
     rid: int
     arrival_s: float
@@ -67,6 +73,8 @@ class Request:
     seed: int
     deadline_s: Optional[float] = None
     prompt_period: Optional[int] = None
+    prefix_len: Optional[int] = None
+    prefix_seed: Optional[int] = None
 
     @property
     def total_tokens(self) -> int:
@@ -111,7 +119,8 @@ class TrafficTrace:
             # pre-feature traces stay byte-stable
             "requests": [
                 {k: v for k, v in asdict(r).items()
-                 if k not in ("deadline_s", "prompt_period")
+                 if k not in ("deadline_s", "prompt_period",
+                              "prefix_len", "prefix_seed")
                  or v is not None}
                 for r in self.requests
             ],
@@ -231,6 +240,8 @@ def generate_trace(
     depth: float = 0.8,
     deadline_s: Optional[float] = None,
     prompt_period: Optional[int] = None,
+    prefix_groups: Optional[int] = None,
+    prefix_len: Optional[int] = None,
 ) -> TrafficTrace:
     """Generate a seeded, replayable trace.
 
@@ -241,8 +252,15 @@ def generate_trace(
     ``prompt_period`` stamps every request with a repeating-structure
     prompt (motif of that many positions tiled to the prompt length —
     the speculative-decoding bench's trace variant; None = fully random
-    prompts, the original schema).  The same ``(kind, num_requests,
-    seed, params)`` always yields the identical trace.
+    prompts, the original schema).  ``prefix_groups`` splits the trace
+    into that many seeded shared-prefix populations: each request joins
+    a group and shares its first ``prefix_len`` prompt positions
+    (clamped to ``prompt_len - 1``; default the midpoint of
+    ``prompt_range``) with every other member — the system-prompt /
+    few-shot-header traffic shape the prefix cache exploits.  The group
+    draws happen AFTER all original draws, so prefix-free traces stay
+    byte-identical to the pre-feature schema.  The same ``(kind,
+    num_requests, seed, params)`` always yields the identical trace.
     """
     if kind not in TRACE_KINDS:
         raise ValueError(
@@ -282,11 +300,41 @@ def generate_trace(
                 f"prompt_period must be >= 1, got {prompt_period}"
             )
         params["prompt_period"] = prompt_period
+    prefix_lens = prefix_seeds = None
+    if prefix_groups is not None:
+        if prefix_groups < 1:
+            raise ValueError(
+                f"prefix_groups must be >= 1, got {prefix_groups}"
+            )
+        if prefix_len is None:
+            prefix_len = (prompt_range[0] + prompt_range[1]) // 2
+        if prefix_len < 1:
+            raise ValueError(f"prefix_len must be >= 1, got {prefix_len}")
+        if prompt_range[0] < 2:
+            raise ValueError(
+                "prefix_groups needs prompt_range lo >= 2 (every request "
+                "must keep at least one per-request position after its "
+                "shared prefix)"
+            )
+        # drawn after every original draw: prefix-free traces are
+        # byte-identical to the pre-feature schema
+        group_seeds = rng.integers(0, 2**31 - 1, size=prefix_groups)
+        membership = rng.integers(0, prefix_groups, size=num_requests)
+        prefix_seeds = [int(group_seeds[g]) for g in membership]
+        prefix_lens = [min(prefix_len, int(prompts[i]) - 1)
+                       for i in range(num_requests)]
+        params.update({"prefix_groups": prefix_groups,
+                       "prefix_len": prefix_len})
+    elif prefix_len is not None:
+        raise ValueError("prefix_len requires prefix_groups")
     requests = tuple(
         Request(rid=i, arrival_s=float(arrivals[i]),
                 prompt_len=int(prompts[i]), output_len=int(outputs[i]),
                 seed=int(seeds[i]), deadline_s=deadline_s,
-                prompt_period=prompt_period)
+                prompt_period=prompt_period,
+                prefix_len=None if prefix_lens is None else prefix_lens[i],
+                prefix_seed=(None if prefix_seeds is None
+                             else prefix_seeds[i]))
         for i in range(num_requests)
     )
     return TrafficTrace(kind=kind, seed=seed, params=params,

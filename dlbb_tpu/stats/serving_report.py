@@ -24,6 +24,8 @@ CSV_COLUMNS = (
     "fused_steps", "prefill_chunks", "retries",
     "speculation", "spec_gamma", "acceptance_rate", "mean_accepted_len",
     "draft_overhead_s",
+    "kv_quant", "prefix_hit_rate", "prefix_tokens_reused",
+    "prefix_cow_blocks",
     "wall_seconds",
 )
 
@@ -66,10 +68,12 @@ def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
     serving = report.get("serving", {})
     fast = report.get("fast_path", {})
     spec = report.get("speculation", {})
+    pre = report.get("prefix", {})
     shed_rate, rej_wait_ms = _rejection_stats(req)
     acc = spec.get("acceptance_rate")
     mal = spec.get("mean_accepted_len")
     draft_s = spec.get("draft_overhead_s")
+    hit_rate = pre.get("hit_rate")
     return {
         "name": name,
         "trace": report.get("trace", {}).get("kind"),
@@ -108,6 +112,17 @@ def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
         "acceptance_rate": None if acc is None else round(acc, 4),
         "mean_accepted_len": None if mal is None else round(mal, 3),
         "draft_overhead_s": None if draft_s is None else round(draft_s, 4),
+        # shared-prefix cache + quantized KV (docs/serving.md, "Prefix
+        # cache & quantized KV"): absent from pre-prefix reports and
+        # prefix-off runs — all None then
+        "kv_quant": (pre.get("kv_quantization")
+                     or serving.get("kv_quantization")),
+        "prefix_hit_rate": (None if not pre.get("enabled") or
+                            hit_rate is None else round(hit_rate, 4)),
+        "prefix_tokens_reused": (pre.get("tokens_reused")
+                                 if pre.get("enabled") else None),
+        "prefix_cow_blocks": (pre.get("cow_blocks")
+                              if pre.get("enabled") else None),
         "wall_seconds": round(report.get("wall_seconds", 0.0), 3),
     }
 
@@ -164,15 +179,21 @@ def write_serving_report(results_dir: "str | Path",
         "accepted, \"acc len\" the mean tokens committed per verify "
         "unit (accepted prefix + the verify's own bonus token), and "
         "\"draft s\" the host wall spent dispatching the draft model "
-        "(docs/serving.md, \"Speculative decoding\").",
+        "(docs/serving.md, \"Speculative decoding\").  \"kv\" is the "
+        "KV-cache wire layout (int8 = quantized planes + fp32 scales), "
+        "\"pfx hit\" the shared-prefix attach rate (prefix-cache hits / "
+        "prefills) and \"pfx tok\" the prompt tokens whose prefill was "
+        "skipped by attaching refcounted donor blocks (docs/serving.md, "
+        "\"Prefix cache & quantized KV\").",
         "",
         "| run | trace | req | done | rej | failed | shed | dl shed | "
         "late | rej wait ms | mesh | "
         "goodput tok/s | "
         "TTFT p50/p99/p99.9 ms | tok p50/p99/p99.9 ms | peak queue | "
-        "peak blocks | spec | acc | acc len | draft s |",
+        "peak blocks | spec | acc | acc len | draft s | kv | pfx hit | "
+        "pfx tok |",
         "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-        "---|---|---|---|---|",
+        "---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         shed = ("-" if r["shed_rate"] is None
@@ -191,6 +212,11 @@ def write_serving_report(results_dir: "str | Path",
                else f"{r['mean_accepted_len']:.2f}")
         draft_s = ("-" if r["draft_overhead_s"] is None
                    else f"{r['draft_overhead_s']:.3f}")
+        kv = r["kv_quant"] or "-"
+        pfx_hit = ("-" if r["prefix_hit_rate"] is None
+                   else f"{r['prefix_hit_rate'] * 100:.0f}%")
+        pfx_tok = ("-" if r["prefix_tokens_reused"] is None
+                   else r["prefix_tokens_reused"])
         lines.append(
             f"| {r['name']} | {r['trace']} | {r['requests']} | "
             f"{r['completed']} | {r['rejected']} | {failed} | {shed} | "
@@ -201,7 +227,8 @@ def write_serving_report(results_dir: "str | Path",
             f"{r['per_token_p50_ms']}/{r['per_token_p99_ms']}/"
             f"{r['per_token_p999_ms']} | "
             f"{r['peak_queue_depth']} | {r['peak_blocks_in_use']} | "
-            f"{spec} | {acc} | {mal} | {draft_s} |"
+            f"{spec} | {acc} | {mal} | {draft_s} | {kv} | {pfx_hit} | "
+            f"{pfx_tok} |"
         )
     lines.append("")
     atomic_write_text("\n".join(lines), out / "SERVING.md")
@@ -387,4 +414,163 @@ def write_speculative_report(bench_path: "str | Path",
         )
     lines.append("")
     atomic_write_text("\n".join(lines), out / "SPECULATIVE.md")
+    return rows
+
+
+def write_prefix_report(bench_path: "str | Path",
+                        output_dir: "str | Path") -> list[dict[str, Any]]:
+    """The shared-prefix / quantized-KV comparison table: consolidate
+    ``BENCH_prefix.json`` (``scripts/bench_prefix.py`` — prefix-share x
+    {none, int8} over the same seeded shared-prefix traces, equivalence
+    gate first) into ``PREFIX.md``.  Returns the rows (empty when the
+    bench artifact is missing/unreadable — callers skip, never
+    clobber)."""
+    bench_path = Path(bench_path)
+    try:
+        bench = json.loads(bench_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return []
+    settings = bench.get("settings", {})
+    if not settings:
+        return []
+    traces = bench.get("traces", {})
+    capacity = bench.get("capacity", {})
+    acceptance = bench.get("acceptance", {})
+    rows = []
+    for name, s in settings.items():
+        tps = s.get("output_tokens_per_s", {})
+        rows.append({
+            "setting": name,
+            "trace": s.get("trace"),
+            "prefix_caching": s.get("prefix_caching"),
+            "kv_quantization": s.get("kv_quantization"),
+            "output_tok_s_median": tps.get("median"),
+            "output_tok_s_min": tps.get("min"),
+            "output_tok_s_max": tps.get("max"),
+            "ttft_p50_ms": s.get("ttft_p50_ms"),
+            "per_token_p50_ms": s.get("per_token_p50_ms"),
+            "prefix_hit_rate": s.get("prefix_hit_rate"),
+            "tokens_reused": s.get("tokens_reused"),
+            "token_identical": s.get("token_identical"),
+            "token_identity_fraction": s.get("token_identity_fraction"),
+            "baseline": s.get("baseline"),
+            "ttft_speedup": s.get("ttft_speedup_vs_baseline"),
+            "goodput_speedup": s.get("goodput_speedup_vs_baseline"),
+            "status": s.get("status", "ok"),
+        })
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    share_note = "; ".join(
+        f"`{t}`: {v.get('shared_token_share', 0) * 100:.0f}% shared "
+        f"(groups={v.get('prefix_groups')}, "
+        f"prefix_len={v.get('prefix_len')})"
+        for t, v in sorted(traces.items())) or "-"
+    lines = [
+        "# Shared-prefix KV cache & quantized KV planes",
+        "",
+        f"Source: `{bench_path.name}` "
+        "(`scripts/bench_prefix.py` — every setting replays the SAME "
+        "seeded shared-prefix trace as its baseline, settings "
+        "interleaved within each repetition so host drift cancels; "
+        "medians of per-rep throughput with min/max spread).  The "
+        "equivalence gate runs FIRST on the published traces, against "
+        "the no-sharing fp engine: fp prefix-cached settings must be "
+        "BIT-EXACT; int8 settings are gated within tolerance (a "
+        "minimum fraction of requests fully token-identical — one "
+        "flipped argmax diverges the rest of that request's greedy "
+        "feedback, so the per-request fraction is the honest scalar, "
+        "shown in \"identical\").  TTFT is arrival-to-first-token; each "
+        "speedup is against the prefix-off fp engine on the SAME mesh "
+        "and trace.  \"hit\" is prefix-cache attaches / prefills, "
+        "\"reused\" the prompt tokens whose prefill was skipped by "
+        "attaching refcounted donor blocks "
+        "(docs/serving.md, \"Prefix cache & quantized KV\").  "
+        f"Traces: {share_note}.",
+        "",
+        "| setting | trace | prefix | kv | out tok/s (min..max) | "
+        "TTFT p50 ms | tok p50 ms | hit | reused | identical | "
+        "TTFT speedup | goodput speedup |",
+        "|---|---|---|---|---|---|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        tps = ("-" if r["output_tok_s_median"] is None else
+               f"{r['output_tok_s_median']:.0f} "
+               f"({r['output_tok_s_min']:.0f}.."
+               f"{r['output_tok_s_max']:.0f})")
+        hit = ("-" if r["prefix_hit_rate"] is None
+               else f"{r['prefix_hit_rate'] * 100:.0f}%")
+        reused = "-" if r["tokens_reused"] is None else r["tokens_reused"]
+        # fp rows are gated bit-exact (yes/NO); int8 rows are gated
+        # within tolerance — show the per-request identity fraction
+        frac = r["token_identity_fraction"]
+        if r["token_identical"] is None:
+            ident = "-"
+        elif r["token_identical"]:
+            ident = "yes"
+        elif frac is not None:
+            ident = f"{frac * 100:.0f}% reqs"
+        else:
+            ident = "NO"
+        tsp = ("-" if r["ttft_speedup"] is None
+               else f"{r['ttft_speedup']:.2f}x")
+        gsp = ("-" if r["goodput_speedup"] is None
+               else f"{r['goodput_speedup']:.2f}x")
+        if r["status"] == "pending_tunnel":
+            tps, tsp, gsp = "pending_tunnel", "-", "-"
+        lines.append(
+            f"| {r['setting']} | {r['trace'] or '-'} | "
+            f"{'on' if r['prefix_caching'] else 'off'} | "
+            f"{r['kv_quantization'] or 'none'} | {tps} | "
+            f"{r['ttft_p50_ms']} | {r['per_token_p50_ms']} | "
+            f"{hit} | {reused} | {ident} | {tsp} | {gsp} |"
+        )
+    if capacity:
+        res = capacity.get("resident_requests", {})
+        per_req = capacity.get("per_request_bytes_per_device", {})
+        lines += [
+            "",
+            "## Static capacity under the HBM budget",
+            "",
+            "Priced by `kv_cache_bytes_per_device` (the same formula "
+            "the build-time budget gate and the static memory audit's "
+            "`serving-cache-drift` pin cross-check against the "
+            "compiled decode carry — not a separate estimate): "
+            "resident requests admissible under "
+            f"`hbm_budget_gb={capacity.get('hbm_budget_gb')}` at "
+            f"max_seq={capacity.get('max_seq')}, "
+            f"block_size={capacity.get('block_size')}, "
+            f"mesh dp{capacity.get('dp', 1)} x tp{capacity.get('tp')}.",
+            "",
+            "| kv layout | bytes/request/device | resident requests |",
+            "|---|---|---|",
+            f"| none (fp32) | {per_req.get('none')} | "
+            f"{res.get('none')} |",
+            f"| int8 + fp32 scales | {per_req.get('int8')} | "
+            f"{res.get('int8')} |",
+            "",
+            f"Capacity ratio: **{capacity.get('capacity_ratio')}x** "
+            f"(bar >= {capacity.get('min_ratio')}x: "
+            f"{'PASS' if capacity.get('passed') else 'FAIL'}).",
+        ]
+    checks = []
+    ttft_acc = acceptance.get("ttft", {})
+    if ttft_acc:
+        checks.append(
+            f"TTFT p50 `{ttft_acc.get('setting')}` vs "
+            f"`{ttft_acc.get('baseline')}`: "
+            f"{ttft_acc.get('measured_speedup')}x "
+            f"(bar >= {ttft_acc.get('min_speedup')}x: "
+            f"{'PASS' if ttft_acc.get('passed') else 'FAIL'})")
+    cap_acc = acceptance.get("capacity", {})
+    if cap_acc:
+        checks.append(
+            f"int8 resident-request capacity: "
+            f"{cap_acc.get('measured_ratio')}x "
+            f"(bar >= {cap_acc.get('min_ratio')}x: "
+            f"{'PASS' if cap_acc.get('passed') else 'FAIL'})")
+    if checks:
+        lines += ["", "## Checked claims", ""]
+        lines += [f"- {c}" for c in checks]
+    lines.append("")
+    atomic_write_text("\n".join(lines), out / "PREFIX.md")
     return rows

@@ -219,6 +219,22 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_serve_fastpath.py -q \
     -m serve_fastpath_smoke -p no:cacheprovider
 
+# prefix_smoke (docs/serving.md, "Prefix cache & quantized KV"): the
+# shared-prefix / quantized-KV equivalence contract — the prefix-cached
+# fp engine must produce IDENTICAL completed-token sequences to the
+# no-sharing engine on a seeded shared-prefix mini-trace (an attach
+# copies the exact block values the skipped chunks would have
+# computed), the int8 engine completes the same trace, the trie's
+# refcount/CoW accounting drains to zero shared blocks, and the bench
+# artifacts carry prefix-attach journal events + hit counters + the
+# quantized HBM record.  The HLO-side contract (shared-prefix attach =
+# ZERO collectives; int8 decode's donated carry priced from the
+# quantized layout) is enforced by `analyze all` above via the
+# serve/engine.py::{prefix_attach,decode_step[int8]} targets, and
+# `analyze diff` against the committed baselines — zero suppressions.
+JAX_PLATFORMS=cpu python -m pytest tests/test_prefix.py -q \
+    -m prefix_smoke -p no:cacheprovider
+
 # serve_chaos_smoke (docs/resilience.md, serving faults): the serving
 # fault matrix through the real continuous-batching engine on the
 # simulated mesh — seeded mini-trace per serving fault class asserting

@@ -95,6 +95,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "prefix_smoke: shared-prefix / quantized-KV smoke — prefix-"
+        "cached and int8-KV engines must produce identical completed-"
+        "token sequences to the no-sharing fp engine on a seeded "
+        "shared-prefix mini-trace, with refcount/trie/CoW accounting "
+        "consistent at drain (tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "serve_chaos_smoke: serving resilience smoke — seeded "
         "mini-traces per serving fault class (dispatch retry+rollback, "
         "hung-dispatch watchdog, torn bookkeeping, per-request "

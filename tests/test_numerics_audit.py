@@ -173,6 +173,38 @@ ENTRY %main (x: s8[1024]) -> s8[1024] {
 }
 """
 
+# a masking select (other side a broadcast constant fill) is
+# layout-only: the trace walks through it and the roundtrip still trips
+SEEDED_MASKED_ROUNDTRIP = """\
+HloModule seeded_masked_roundtrip, entry_computation_layout={(s8[1024]{0}, pred[1024]{0})->s8[1024]{0}}
+
+ENTRY %main (x: s8[1024], m: pred[1024]) -> s8[1024] {
+  %x = s8[1024]{0} parameter(0)
+  %m = pred[1024]{0} parameter(1)
+  %dq = f32[1024]{0} convert(s8[1024]{0} %x)
+  %zero = f32[] constant(0)
+  %fill = f32[1024]{0} broadcast(f32[] %zero), dimensions={}
+  %masked = f32[1024]{0} select(pred[1024]{0} %m, f32[1024]{0} %fill, f32[1024]{0} %dq)
+  ROOT %q = s8[1024]{0} convert(f32[1024]{0} %masked)
+}
+"""
+
+# the int8 decode-append shape: dequantise -> select MERGING a live
+# data stream (the fresh token's K/V) -> requantise.  The merge is real
+# work, so the trace aborts and no finding is emitted.
+SEEDED_MERGE_HOP = """\
+HloModule seeded_merge_hop, entry_computation_layout={(s8[1024]{0}, f32[1024]{0}, pred[1024]{0})->s8[1024]{0}}
+
+ENTRY %main (x: s8[1024], fresh: f32[1024], m: pred[1024]) -> s8[1024] {
+  %x = s8[1024]{0} parameter(0)
+  %fresh = f32[1024]{0} parameter(1)
+  %m = pred[1024]{0} parameter(2)
+  %dq = f32[1024]{0} convert(s8[1024]{0} %x)
+  %merged = f32[1024]{0} select(pred[1024]{0} %m, f32[1024]{0} %fresh, f32[1024]{0} %dq)
+  ROOT %q = s8[1024]{0} convert(f32[1024]{0} %merged)
+}
+"""
+
 # the legitimate ring hop: dequantise -> ACCUMULATE (equal-size add)
 # -> requantise.  The add aborts the trace, so no finding.
 SEEDED_RING_HOP = """\
@@ -198,6 +230,21 @@ def test_seeded_quantise_roundtrip():
 def test_ring_hop_requantise_is_legitimate():
     findings, _ = analyze_numerics(
         SEEDED_RING_HOP, TargetExpectation(), "seed::ring-hop")
+    assert findings == []
+
+
+def test_masking_select_roundtrip_still_trips():
+    findings, _ = analyze_numerics(
+        SEEDED_MASKED_ROUNDTRIP, TargetExpectation(), "seed::masked")
+    assert _rules(findings) == ["quantise-roundtrip"]
+
+
+def test_merge_select_requantise_is_legitimate():
+    """The int8 decode-append idiom: requantising after a select that
+    writes a live data stream over the dequantised window is real work,
+    not a no-op roundtrip."""
+    findings, _ = analyze_numerics(
+        SEEDED_MERGE_HOP, TargetExpectation(), "seed::merge-hop")
     assert findings == []
 
 
