@@ -368,6 +368,15 @@ def build_parser() -> argparse.ArgumentParser:
                     dest="spec_adaptive",
                     help="per-request adaptive γ: back off to a smaller "
                          "verify width on low acceptance EMA")
+    sv.add_argument("--temperature", type=float, default=None,
+                    help="sampled decode: softmax temperature of the "
+                         "residual-sampling verify path (requires a "
+                         "drafting speculation mode and "
+                         "decode_horizon=1; default 0 = greedy argmax)")
+    sv.add_argument("--sample-seed", type=int, default=None,
+                    dest="sample_seed",
+                    help="host RNG seed for the sampled (temperature "
+                         "> 0) path — makes sampled runs replayable")
     sv.add_argument("--prefix-caching", action="store_true", default=None,
                     dest="prefix_caching",
                     help="shared-prefix KV reuse: content-address full "
@@ -447,6 +456,82 @@ def build_parser() -> argparse.ArgumentParser:
                          "(outside every timed region) under DIR; "
                          "DLBB_DEVICE_TRACE env is the default; parsed "
                          "by `obs devtrace` (docs/observability.md)")
+
+    pl = sub.add_parser(
+        "plan",
+        help="cm2-driven parallelism-plan autotuner: enumerate the full "
+             "plan space, statically prune (validate_*/HBM, every pruned "
+             "point journaled with its reason), rank by the fitted cost "
+             "model, measure the top-k through the real engines "
+             "(--auto); or price a fleet capacity curve over a traffic "
+             "trace + SLO (--capacity) (docs/autotune.md)",
+    )
+    mode = pl.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--auto", action="store_true",
+                      help="run the predict-prune-measure plan search")
+    mode.add_argument("--capacity", action="store_true",
+                      help="run the fleet capacity planner (predicted vs "
+                           "measured goodput/TTFT per plan + replicas-"
+                           "for-N-users curve, published to SERVING.md)")
+    pl.add_argument("--target", default="serving",
+                    choices=("serving", "train"),
+                    help="which engine's plan space to search (--auto)")
+    pl.add_argument("--top-k", type=int, default=2, dest="top_k",
+                    help="cm2-ranked plans to validate with real "
+                         "measured runs (the default heuristic plan is "
+                         "always measured too)")
+    pl.add_argument("--no-measure", action="store_true",
+                    dest="no_measure",
+                    help="static search only: enumerate, prune, rank — "
+                         "skip the measured validation runs")
+    pl.add_argument("--no-mesh-champions", action="store_true",
+                    dest="no_mesh_champions",
+                    help="measure only the overall top-k (default: also "
+                         "measure the predicted-best plan of every "
+                         "surviving mesh factorization, so a mesh the "
+                         "model mis-ranks still reaches the agreement "
+                         "table)")
+    pl.add_argument("--trace", default="poisson",
+                    help="traffic kind for the measured serving runs "
+                         "(poisson, bursty, diurnal) or a saved trace")
+    pl.add_argument("--requests", type=int, default=24,
+                    help="requests per measured serving run")
+    pl.add_argument("--rate", type=float, default=None,
+                    help="mean arrival rate in req/s (default 32)")
+    pl.add_argument("--seed", type=int, default=42,
+                    help="trace seed (shared by every measured run)")
+    pl.add_argument("--prompt-range", type=int, nargs=2, default=None,
+                    dest="prompt_range", metavar=("MIN", "MAX"),
+                    help="generated traces only: prompt-length bounds")
+    pl.add_argument("--output-range", type=int, nargs=2, default=None,
+                    dest="output_range", metavar=("MIN", "MAX"),
+                    help="generated traces only: output-length bounds "
+                         "(the committed reference workload saturates "
+                         "decode with --rate 1e5 --prompt-range 8 16 "
+                         "--output-range 240 240)")
+    pl.add_argument("--slo", type=float, default=30.0,
+                    help="TTFT SLO in seconds (--capacity; stamps the "
+                         "trace's deadline_s)")
+    pl.add_argument("--user-rate", type=float, default=0.2,
+                    dest="user_rate",
+                    help="req/s one user issues (--capacity curve)")
+    pl.add_argument("--users", type=int, nargs="+",
+                    default=(4, 8, 16, 32, 64),
+                    help="N-user points on the capacity curve")
+    pl.add_argument("--fit-dir", default=None, dest="fit_dir",
+                    help="cm2 fitted-coefficient DB directory (default "
+                         "stats/analysis/costmodel_fit; a missing fit "
+                         "fails the search closed: every point is "
+                         "journaled cm2-fit-missing)")
+    pl.add_argument("--tier", default=None,
+                    help="cost-model tier (default cpu-sim)")
+    pl.add_argument("--output", default=None,
+                    help="output directory (default results/autotune or "
+                         "results/capacity)")
+    pl.add_argument("--bench-out", default=None, dest="bench_out",
+                    help="also write the repo-root bench artifact "
+                         "(BENCH_autotune.json; --auto only)")
+    pl.add_argument("--simulate", type=int, default=0, metavar="N")
 
     tr = sub.add_parser("train", help="DDP/ZeRO-{1,2,3} training-loop benchmark")
     tr.add_argument("--config", required=True, help="YAML experiment config")
@@ -734,6 +819,21 @@ def _dispatch(args) -> int:
         else:
             print("fastpath: no BENCH_serve.json at the repo root — "
                   "skipped")
+        bench_autotune = Path("BENCH_autotune.json")
+        if bench_autotune.exists():
+            from dlbb_tpu.stats.parallelism_report import (
+                write_autotune_report,
+            )
+
+            arows = write_autotune_report(bench_autotune,
+                                          stats_root / "parallelism")
+            if arows:
+                produced += 1
+                print(f"autotune: {len(arows)} measured plan(s) -> "
+                      f"{stats_root / 'parallelism' / 'AUTOTUNE.md'}")
+        else:
+            print("autotune: no BENCH_autotune.json at the repo root — "
+                  "skipped")
         from dlbb_tpu.stats.northstar import (
             default_stats_1d_csv,
             write_northstar_report,
@@ -823,6 +923,8 @@ def _dispatch(args) -> int:
                     args.dispatch_deadline_factor,
                 "prefix_caching": args.prefix_caching,
                 "kv_quantization": args.kv_quantization,
+                "temperature": args.temperature,
+                "sample_seed": args.sample_seed,
             },
             resume=args.resume,
             fault_plan=args.fault_plan,
@@ -852,6 +954,46 @@ def _dispatch(args) -> int:
             f"request(s)"
         )
         return 0
+
+    if args.cmd == "plan":
+        from dlbb_tpu.analysis.costmodel import DEFAULT_TIER
+
+        tier_name = args.tier or DEFAULT_TIER
+        n_dev = args.simulate
+        if not n_dev:
+            import jax
+
+            n_dev = len(jax.devices())
+        trace_params = {}
+        if args.prompt_range:
+            trace_params["prompt_range"] = tuple(args.prompt_range)
+        if args.output_range:
+            trace_params["output_range"] = tuple(args.output_range)
+        if args.capacity:
+            from dlbb_tpu.plan.autotune import run_capacity_plan
+
+            run_capacity_plan(
+                n_devices=n_dev, slo=args.slo, users=tuple(args.users),
+                user_rate=args.user_rate, trace=args.trace,
+                num_requests=args.requests, seed=args.seed,
+                rate=args.rate, trace_params=trace_params or None,
+                output_dir=args.output or "results/capacity",
+                tier_name=tier_name, fit_dir=args.fit_dir,
+            )
+            return 0
+        from dlbb_tpu.plan.autotune import run_plan_search
+
+        result = run_plan_search(
+            target=args.target, n_devices=n_dev, top_k=args.top_k,
+            output_dir=args.output or "results/autotune",
+            trace=args.trace, num_requests=args.requests,
+            seed=args.seed, rate=args.rate,
+            trace_params=trace_params or None, tier_name=tier_name,
+            fit_dir=args.fit_dir, measure=not args.no_measure,
+            mesh_champions=not args.no_mesh_champions,
+            bench_out=args.bench_out,
+        )
+        return 1 if result.get("error") else 0
 
     if args.cmd == "train":
         try:

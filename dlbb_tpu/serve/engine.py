@@ -267,6 +267,26 @@ class ServingConfig:
                      prices the quantized layout statically).
                      Requires speculation="off" and no
                      compact_threshold (fp-cache-only programs).
+    temperature:     softmax temperature of the SAMPLED decode path
+                     (0.0 = the greedy argmax law, bit-for-bit
+                     untouched).  temperature > 0 routes every decode
+                     unit through the residual-sampling verify
+                     (``speculative_sample`` — Leviathan et al. 2023):
+                     the target's verify logits come to host, each
+                     drafted position is accepted with probability
+                     ``p[draft]`` and rejected positions resample from
+                     ``residual_distribution`` — the composite law is
+                     exactly the temperature-``T`` softmax of the
+                     target, so sampled speculative decode is
+                     distribution-identical (not token-identical) to a
+                     sequential sampler.  Requires a drafting
+                     speculation mode, decode_horizon=1 and no
+                     prefill_chunk (the fused/chunk-interleave token
+                     programs are greedy-argmax only — running them
+                     would silently emit greedy tokens mid-sampled-run).
+    sample_seed:     host RNG seed of the sampled path (with the trace
+                     seed this makes sampled runs replayable); only
+                     meaningful with temperature > 0.
     """
 
     max_batch: int = 8
@@ -292,6 +312,8 @@ class ServingConfig:
     spec_draft_kv_heads: Optional[int] = None
     prefix_caching: bool = False
     kv_quantization: str = "none"
+    temperature: float = 0.0
+    sample_seed: int = 0
 
     def __post_init__(self) -> None:
         if not self.prefill_buckets:
@@ -534,6 +556,47 @@ class ServingConfig:
                     "repack the fp cache layout only, so compaction "
                     "would silently run on stale scale planes"
                 )
+        # -- sampled decode (same no-op-trap contract) --
+        if self.temperature < 0:
+            raise ValueError(
+                f"serving.temperature must be >= 0, got "
+                f"{self.temperature}"
+            )
+        if self.temperature > 0:
+            if not self.spec_drafting:
+                raise ValueError(
+                    f"serving.temperature={self.temperature} requires a "
+                    "drafting speculation mode ('ngram' or "
+                    "'draft-model'): the sampled path runs inside the "
+                    "verify unit (residual sampling over the verify "
+                    "logits), and with speculation="
+                    f"{self.speculation!r} every decode program is the "
+                    "greedy argmax law — the knob would silently emit "
+                    "greedy tokens"
+                )
+            if self.decode_horizon != 1:
+                raise ValueError(
+                    f"serving.temperature={self.temperature} requires "
+                    f"decode_horizon=1 (got {self.decode_horizon}): the "
+                    "fused token scans are greedy-argmax programs, so a "
+                    "fused unit mid-sampled-run would silently emit "
+                    "greedy tokens (the verify window is the sampled "
+                    "path's multi-token mechanism)"
+                )
+            if self.prefill_chunk is not None:
+                raise ValueError(
+                    f"serving.temperature={self.temperature} cannot "
+                    "combine with prefill_chunk: the chunk interleave's "
+                    "per-step decode units are greedy token programs, "
+                    "so a long admission would silently emit greedy "
+                    "tokens mid-sampled-run"
+                )
+        elif self.sample_seed:
+            raise ValueError(
+                f"serving.sample_seed={self.sample_seed} requires "
+                "temperature > 0: the greedy path never consumes the "
+                "host RNG, so the knob would be a silent no-op"
+            )
 
     @property
     def spec_drafting(self) -> bool:
@@ -587,7 +650,7 @@ class ServingConfig:
                   "dispatch_deadline_min_s", "speculation", "spec_gamma",
                   "spec_adaptive", "spec_draft_layers",
                   "spec_draft_kv_heads", "prefix_caching",
-                  "kv_quantization"):
+                  "kv_quantization", "temperature", "sample_seed"):
             if k in d:
                 fields[k] = d[k]
         if "prefill_buckets" in d:
@@ -620,6 +683,8 @@ class ServingConfig:
             "spec_draft_kv_heads": self.spec_draft_kv_heads,
             "prefix_caching": self.prefix_caching,
             "kv_quantization": self.kv_quantization,
+            "temperature": self.temperature,
+            "sample_seed": self.sample_seed,
         }
 
     @property
@@ -1273,6 +1338,19 @@ def _inject_token_greedy(carry, slot, vec, table):
             tok)
 
 
+def _inject_token_sampled(carry, slot, tok, table):
+    """Sampled-mode admission inject: the HOST already sampled the
+    first token from the prefill's softmax (``temperature > 0``), so
+    the device only embeds the committed id — ``x[slot, 0] =
+    table[tok]`` (the greedy inject with the argmax replaced by the
+    host's draw)."""
+    cache, x = carry
+    emb = jnp.take(table, tok.astype(jnp.int32), axis=0)
+    return (cache,
+            jnp.where((jnp.arange(x.shape[0]) == slot)[:, None, None],
+                      emb[None, None, :].astype(x.dtype), x))
+
+
 def _verify_attention(q: jax.Array, k_flat: jax.Array, v_flat: jax.Array,
                       valid: jax.Array) -> jax.Array:
     """Offset-causal length-masked attention for one verify step.
@@ -1465,6 +1543,99 @@ def build_verify_step(config: ModelConfig, mesh: Mesh, gamma: int):
     )
 
 
+def build_verify_probs(config: ModelConfig, mesh: Mesh, gamma: int):
+    """The SAMPLED verify's device half: ``build_verify_step``'s exact
+    batched γ+1-position forward (same one-hot K/V appends at
+    ``lengths + i``, same offset-causal mask), but acceptance moves to
+    the HOST — the program returns the raw verify logits ``y [B, γ+1,
+    H]`` and commits NOTHING: lengths and ``x`` come back unchanged,
+    so the appended-but-uncommitted cache positions sit past every
+    slot's length (dead by the usual mask construction) until the
+    host's residual-sampling pass decides the true commits and the
+    tiny ``build_spec_commit`` program advances the carry.  Re-running
+    the program on the returned carry is therefore idempotent — the
+    retry ladder's contract.
+
+    ``gamma=0`` degenerates to a plain decode step that returns its
+    softmax-able logits without committing — the sampled path's
+    cold-drafter fallback unit (one sampled token per trip)."""
+    g1 = gamma + 1
+
+    def verify_probs(carry, params, table, draft_ids, active):
+        cache, x = carry
+        b_dim, s_max = cache.max_batch, cache.max_seq
+        nb, bs = cache.num_blocks, cache.block_size
+        n, d, kvh = config.num_heads, config.head_dim, config.kv_heads
+        lengths = cache.lengths
+        d_emb = jnp.take(table, draft_ids, axis=0).astype(x.dtype)
+        h0 = jnp.concatenate([x, d_emb], axis=1)        # [B, γ+1, H]
+        pos = jnp.arange(s_max)[None, :]                # [1, S]
+        offs = lengths[:, None] + jnp.arange(g1)[None, :]   # [B, γ+1]
+        valid = pos[:, None, :] <= offs[:, :, None]     # [B, γ+1, S]
+
+        def attention_step(q, k, v, cache_state):
+            k_l, v_l = cache_state
+            qh = _heads(q, n, d)
+            k_new = k.reshape(b_dim, g1, kvh, d)
+            v_new = v.reshape(b_dim, g1, kvh, d)
+            k_flat = k_l.reshape(b_dim, s_max, kvh, d)
+            v_flat = v_l.reshape(b_dim, s_max, kvh, d)
+            for i in range(g1):
+                m = ((pos == lengths[:, None] + i)
+                     & active[:, None])[..., None, None]
+                k_flat = jnp.where(m, k_new[:, i][:, None], k_flat)
+                v_flat = jnp.where(m, v_new[:, i][:, None], v_flat)
+            attn = _verify_attention(qh, k_flat, v_flat, valid)
+            return (attn.transpose(0, 2, 1, 3).reshape(b_dim, g1, n * d),
+                    (k_flat.reshape(b_dim, nb, bs, kvh, d),
+                     v_flat.reshape(b_dim, nb, bs, kvh, d)))
+
+        def body(h, layer_and_cache):
+            layer, k_l, v_l = layer_and_cache
+            return _serve_block(h, layer, config, attention_step,
+                                (k_l, v_l))
+
+        h, (k_new, v_new) = jax.lax.scan(
+            body, h0, (params["layers"], cache.k, cache.v)
+        )
+        y = _layernorm(h, params["ln_f"]["scale"], params["ln_f"]["bias"])
+        return (KVCache(k_new, v_new, lengths), x), y
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    dp_ax = decode_batch_spec(mesh)[0]
+    return jax.jit(
+        verify_probs,
+        donate_argnums=(0,),
+        out_shardings=((cache_shardings(mesh), x_sh),
+                       NamedSharding(mesh, P(dp_ax, None, None))),
+    )
+
+
+def build_spec_commit(config: ModelConfig, mesh: Mesh):
+    """The sampled verify's commit half: the host's residual-sampling
+    pass decided ``commits`` (per-slot committed window length) and
+    ``next_ids`` (each slot's LAST committed token — the next unit's
+    input); this tiny program advances lengths by the commits and
+    re-embeds ``x`` from the token table, completing exactly the carry
+    protocol ``build_verify_step`` applies on device for the greedy
+    law.  The rejected suffix needs no cleanup — same dead-by-
+    construction argument as the greedy verify."""
+
+    def spec_commit(carry, table, next_ids, commits, active):
+        cache, x = carry
+        lengths_f = (cache.lengths + commits).astype(jnp.int32)
+        emb = jnp.take(table, next_ids, axis=0)[:, None, :].astype(x.dtype)
+        x_f = jnp.where(active[:, None, None], emb, x)
+        return (KVCache(cache.k, cache.v, lengths_f), x_f)
+
+    x_sh = NamedSharding(mesh, decode_batch_spec(mesh))
+    return jax.jit(
+        spec_commit,
+        donate_argnums=(0,),
+        out_shardings=(cache_shardings(mesh), x_sh),
+    )
+
+
 def build_draft_scan(config: ModelConfig, mesh: Mesh, gamma: int):
     """Jitted draft-model proposal scan: γ greedy token-feedback decode
     steps of the SHALLOW draft transformer over its own donated paged
@@ -1538,6 +1709,18 @@ def _ngram_propose(hist: list, gamma: int,
     return None
 
 
+def softmax_np(logits: np.ndarray, temperature: float) -> np.ndarray:
+    """Host-side temperature softmax (float64, max-subtracted) — the
+    sampled path's target law ``p``.  The device never softmaxes: the
+    verify logits come to host raw and every probability the sampler
+    consumes is computed here, so the sampled law is exactly
+    reproducible from the journal'd seeds."""
+    z = np.asarray(logits, np.float64) / float(temperature)
+    z = z - z.max(axis=-1, keepdims=True)
+    e = np.exp(z)
+    return e / e.sum(axis=-1, keepdims=True)
+
+
 def residual_distribution(p_target: np.ndarray,
                           q_draft: np.ndarray) -> np.ndarray:
     """The rejection-correction distribution of speculative SAMPLING
@@ -1562,10 +1745,13 @@ def speculative_sample(p_target: np.ndarray, q_draft: np.ndarray,
     composite law is exactly ``p`` (distribution-identity, pinned by
     ``tests/test_speculative.py``), so sampled speculative decode is
     distribution-identical — not token-identical — to the sequential
-    sampler.  The engine's serving path is greedy (argmax), which this
-    correction degenerates to as temperature -> 0; the helper documents
-    and tests the sampled contract without wiring a sampler through the
-    scheduler (docs/serving.md)."""
+    sampler.  The engine's default serving path is greedy (argmax),
+    which this correction degenerates to as temperature -> 0; with
+    ``serving.temperature > 0`` the scheduler's verify units run this
+    helper position-by-position over the host-side verify softmax
+    (``q`` = the deterministic drafter's one-hot, so acceptance is
+    ``p[draft]`` and the residual is ``p`` with the draft's mass
+    removed — docs/serving.md)."""
     p = float(p_target[draft_id])
     q = float(q_draft[draft_id])
     accept_p = 1.0 if q <= 0.0 and p > 0.0 else (
@@ -1799,6 +1985,28 @@ class ServingEngine:
                                           donate_argnums=(0,))
             dp_ax = decode_batch_spec(mesh)[0]
             self._ids_sharding = NamedSharding(mesh, P(dp_ax, None))
+        # sampled (temperature > 0) decode: host residual sampling over
+        # the verify logits — verify_probs/spec_commit replace the
+        # greedy on-device verify, and the cold-drafter fallback is the
+        # γ=0 probs program (one sampled token per trip), so a sampled
+        # run NEVER dispatches a greedy token program after prefill
+        self._sampled = serving.temperature > 0
+        self._verify_probs: dict[int, Any] = {}
+        self._spec_commit = None
+        self._inject_sampled = None
+        if self._sampled:
+            probs_gammas = set(self._spec_gammas)
+            if serving.speculation == "ngram":
+                probs_gammas.add(0)     # the cold-drafter fallback unit
+            self._verify_probs = {g: build_verify_probs(config, mesh, g)
+                                  for g in sorted(probs_gammas)}
+            self._spec_commit = build_spec_commit(config, mesh)
+            self._inject_sampled = jax.jit(_inject_token_sampled,
+                                           donate_argnums=(0,))
+            self.registry.inc(
+                "serve_sampled_tokens", 0,
+                help="tokens committed by the sampled (temperature > 0) "
+                     "residual-sampling path")
         if serving.spec_drafting:
             self._verify = {g: build_verify_step(config, mesh, g)
                             for g in self._spec_gammas}
@@ -2042,21 +2250,39 @@ class ServingEngine:
         if self._token_mode:
             # token-feedback warms: the legacy inject/decode/fused jits
             # are never dispatched in a token-mode run, so warming them
-            # would only burn compile time
-            carry, _tok = self._inject_greedy(carry, np.int32(0), y_last,
-                                              self._table)
-            carry, _tok = self._decode_token(carry, self.params,
-                                             self._table, active)
-            for k in self._fused_ks:
-                carry, _toks = self._decode_fused_token[k](
-                    carry, self.params, self._table, active, remaining)
-            for g in self._spec_gammas:
-                ids = jax.device_put(
-                    jnp.zeros((cfg.max_batch, g), jnp.int32),
-                    self._ids_sharding)
-                carry, _tok, _commits = self._verify[g](
-                    carry, self.params, self._table, ids, active,
-                    remaining)
+            # would only burn compile time — and a SAMPLED run likewise
+            # never dispatches the greedy inject/decode/verify programs
+            # (its entire decode surface is verify_probs + spec_commit)
+            if self._sampled:
+                carry = self._inject_sampled(carry, np.int32(0),
+                                             np.int32(0), self._table)
+                zeros_i = jax.device_put(
+                    jnp.zeros((cfg.max_batch,), jnp.int32),
+                    self._active_sharding)
+                for g in sorted(self._verify_probs):
+                    ids = jax.device_put(
+                        jnp.zeros((cfg.max_batch, g), jnp.int32),
+                        self._ids_sharding)
+                    carry, _y = self._verify_probs[g](
+                        carry, self.params, self._table, ids, active)
+                carry = self._spec_commit(carry, self._table, zeros_i,
+                                          remaining, active)
+            else:
+                carry, _tok = self._inject_greedy(carry, np.int32(0),
+                                                  y_last, self._table)
+                carry, _tok = self._decode_token(carry, self.params,
+                                                 self._table, active)
+                for k in self._fused_ks:
+                    carry, _toks = self._decode_fused_token[k](
+                        carry, self.params, self._table, active,
+                        remaining)
+                for g in self._spec_gammas:
+                    ids = jax.device_put(
+                        jnp.zeros((cfg.max_batch, g), jnp.int32),
+                        self._ids_sharding)
+                    carry, _tok, _commits = self._verify[g](
+                        carry, self.params, self._table, ids, active,
+                        remaining)
             if self._draft_config is not None:
                 dcache = self._fresh_draft_cache()
                 for b in buckets:
@@ -2183,6 +2409,11 @@ class ServingEngine:
         # per-rid committed token history (prompt ids + every committed
         # token): the n-gram drafter's lookup context
         hist: dict[int, list[int]] = {}
+        # sampled decode's host RNG: seeded from the config knob so a
+        # (trace, config) pair replays token-for-token — the journal'd
+        # runs stay deterministic even though the law is a distribution
+        sample_rng = (np.random.default_rng(cfg.sample_seed)
+                      if self._sampled else None)
         # the draft model's KV plane rides in a one-slot holder (the
         # closures below rebind it at every dispatch / carry reset);
         # its ledger mirrors the target's accounting — the draft plane
@@ -2644,13 +2875,69 @@ class ServingEngine:
                 else:
                     ids = jax.device_put(jnp.asarray(drafts_np),
                                          self._ids_sharding)
-                carry, tok, commits = dispatch(
-                    lambda: self._verify[g](
-                        carry, self.params, self._table, ids,
-                        active_dev, rem_dev))
-                commits_np = _with_deadline(
-                    lambda: np.asarray(commits), deadline,
-                    f"verify[gamma={g}]", "serve-sync")
+                committed_ids: Optional[dict[int, list[int]]] = None
+                if self._sampled:
+                    # sampled verify: the device computes the γ+1
+                    # verify logits WITHOUT committing (lengths/x come
+                    # back unchanged — retry-idempotent); acceptance is
+                    # the host's residual-sampling pass (the literal
+                    # ``speculative_sample`` helper, q = the
+                    # deterministic drafter's one-hot), and the tiny
+                    # spec_commit program applies the decided commits
+                    carry, y = dispatch(
+                        lambda: self._verify_probs[g](
+                            carry, self.params, self._table, ids,
+                            active_dev))
+                    y_np = _with_deadline(
+                        lambda: np.asarray(y), deadline,
+                        f"verify[gamma={g}]", "serve-sync")
+                    ids_np = (np.asarray(ids)
+                              if cfg.speculation == "draft-model"
+                              else drafts_np)
+                    vocab = y_np.shape[-1]
+                    commits_np = np.zeros((cfg.max_batch,), np.int32)
+                    next_np = np.zeros((cfg.max_batch,), np.int32)
+                    committed_ids = {}
+                    for s, _rid in rows:
+                        p_rows = softmax_np(y_np[s], cfg.temperature)
+                        toks: list[int] = []
+                        for j in range(g):
+                            d_id = int(ids_np[s, j])
+                            q = np.zeros((vocab,), np.float64)
+                            q[d_id] = 1.0
+                            t, ok = speculative_sample(
+                                p_rows[j], q, d_id, sample_rng)
+                            toks.append(t)
+                            if not ok:
+                                break
+                        else:
+                            # every draft accepted: the window's +1
+                            # bonus is a free draw from the last
+                            # position's target distribution
+                            toks.append(int(sample_rng.choice(
+                                vocab, p=p_rows[g])))
+                        m = min(len(toks), rem_map[s])
+                        commits_np[s] = m
+                        next_np[s] = toks[m - 1]
+                        committed_ids[s] = toks[:m]
+                    next_dev = jax.device_put(jnp.asarray(next_np),
+                                              self._active_sharding)
+                    com_dev = jax.device_put(jnp.asarray(commits_np),
+                                             self._active_sharding)
+                    carry = dispatch(
+                        lambda: self._spec_commit(
+                            carry, self._table, next_dev, com_dev,
+                            active_dev))
+                    self.registry.inc("serve_sampled_tokens",
+                                      int(commits_np.sum()))
+                else:
+                    carry, tok, commits = dispatch(
+                        lambda: self._verify[g](
+                            carry, self.params, self._table, ids,
+                            active_dev, rem_dev))
+                    commits_np = _with_deadline(
+                        lambda: np.asarray(commits), deadline,
+                        f"verify[gamma={g}]", "serve-sync")
                 t_ready = time.perf_counter()
                 dt = t_ready - max(t0, last_sync[0])
                 last_sync[0] = t_ready
@@ -2721,7 +3008,9 @@ class ServingEngine:
                 ladder = self._spec_gammas
                 unit_acc = 0
                 tok_np = (np.asarray(tok)
-                          if (drafter == "ngram" or self.capture_tokens)
+                          if (committed_ids is None
+                              and (drafter == "ngram"
+                                   or self.capture_tokens))
                           else None)
                 for s, rid in rows:
                     m = int(commits_np[s])
@@ -2739,7 +3028,7 @@ class ServingEngine:
                                 accepted=acc, committed=m)
                     st = slots[s]
                     if cfg.spec_adaptive:
-                        rate = acc / g
+                        rate = acc / g if g else 0.0
                         st.accept_ema = (rate if st.accept_ema < 0
                                          else 0.5 * st.accept_ema
                                          + 0.5 * rate)
@@ -2751,14 +3040,17 @@ class ServingEngine:
                         elif (st.accept_ema > 0.75
                               and pos < len(ladder) - 1):
                             st.gamma_eff = ladder[pos + 1]
-                    if tok_np is not None:
-                        ids_host = [int(t) for t in tok_np[s, :m]]
+                    if tok_np is not None or committed_ids is not None:
+                        ids_host = (committed_ids[s]
+                                    if committed_ids is not None
+                                    else [int(t) for t in tok_np[s, :m]])
                         if drafter == "ngram" and rid in hist:
                             hist[rid].extend(ids_host)
                         if self.capture_tokens:
                             tokens_by_rid.setdefault(rid, []).extend(
                                 ids_host)
-                unit_rate = unit_acc / (g * len(rows)) if rows else 0.0
+                unit_rate = (unit_acc / (g * len(rows))
+                             if (rows and g) else 0.0)
                 accept_ema_run[0] = (
                     unit_rate if accept_ema_run[0] < 0
                     else 0.5 * accept_ema_run[0] + 0.5 * unit_rate)
@@ -2807,7 +3099,14 @@ class ServingEngine:
                 stats.spec_draft_s += time.perf_counter() - t_d
                 if not any_hit:
                     stats.spec_fallback_units += 1
-                    return False
+                    if not self._sampled:
+                        return False
+                    # sampled cold fallback: the plain token decode
+                    # unit is a greedy program, so a cold drafter
+                    # degenerates to the γ=0 verify — one host-sampled
+                    # token per trip, never a silent greedy token
+                    g = 0
+                    drafts_np = np.zeros((cfg.max_batch, 0), np.int32)
             snap = take_snapshot()
             attempt = 0
             while True:
@@ -3302,7 +3601,21 @@ class ServingEngine:
                             fail_admission(req, slot, e)
                             continue
                         first_id = -1
-                        if token_mode:
+                        if token_mode and self._sampled:
+                            # sampled inject: position 0 obeys the same
+                            # temperature law as every later token —
+                            # the prefill's last logits come to host
+                            # (one [H] vector per admission), the first
+                            # token is drawn from their softmax, and
+                            # the device only embeds the committed id
+                            p0 = softmax_np(np.asarray(y_last),
+                                            cfg.temperature)
+                            first_id = int(sample_rng.choice(
+                                p0.shape[-1], p=p0))
+                            carry = self._inject_sampled(
+                                carry, np.int32(slot),
+                                np.int32(first_id), self._table)
+                        elif token_mode:
                             # greedy token inject: argmax on device, a
                             # 4-byte id to host — the history seed AND
                             # the equivalence capture in one transfer
@@ -3512,6 +3825,9 @@ class ServingEngine:
                 "mode": cfg.speculation,
                 "gamma": cfg.spec_gamma,
                 "adaptive": cfg.spec_adaptive,
+                "temperature": cfg.temperature,
+                "sampled": self._sampled,
+                "sample_seed": cfg.sample_seed,
                 "verify_units": stats.spec_verify_units,
                 "fallback_units": stats.spec_fallback_units,
                 "proposed_tokens": stats.spec_proposed_tokens,

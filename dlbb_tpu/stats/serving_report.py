@@ -231,8 +231,110 @@ def write_serving_report(results_dir: "str | Path",
             f"{pfx_tok} |"
         )
     lines.append("")
+    # the capacity planner's durable record lives next to the report —
+    # regenerating SERVING.md from serving_*.json must not drop the
+    # published capacity curve (docs/autotune.md)
+    cap_path = out / "capacity.json"
+    if cap_path.exists():
+        try:
+            cap = json.loads(cap_path.read_text())
+        except (OSError, json.JSONDecodeError):
+            cap = None
+        if cap:
+            lines.extend(_capacity_lines(cap))
     atomic_write_text("\n".join(lines), out / "SERVING.md")
     return rows
+
+
+def _capacity_lines(report: dict[str, Any]) -> list[str]:
+    """Markdown section for one capacity-planner report
+    (``dlbb_capacity_v1``, ``cli plan --capacity``)."""
+    trace = report.get("trace", {})
+    lines = [
+        "## Fleet capacity curve",
+        "",
+        f"cm2-predicted vs measured per-replica serving capacity "
+        f"(`cli plan --capacity`, docs/autotune.md).  SLO = TTFT within "
+        f"{report.get('slo_s', '?')} s (the trace's `deadline_s`); one "
+        f"**measured** run per plotted plan on the seeded "
+        f"{trace.get('kind', '?')} trace "
+        f"(n={trace.get('num_requests', '?')}, "
+        f"seed={trace.get('seed', '?')}); a user issues "
+        f"{report.get('user_rate_req_per_s', '?')} req/s of "
+        f"~{report.get('mean_output_tokens', '?')} output tokens.  "
+        f"Replica scaling is linear extrapolation (independent engines "
+        f"behind round-robin admission) anchored at the measured "
+        f"single-replica numbers.",
+        "",
+        "| plan | pred tok/s | meas tok/s | pred TTFT ms | "
+        "meas TTFT p50 ms | done | SLO ok |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for p in report.get("plans", []):
+        lines.append(
+            f"| {p['plan']} | "
+            f"{p['predicted_goodput_tokens_per_s']:.0f} | "
+            f"{p['measured_goodput_tokens_per_s']:.0f} | "
+            f"{p['predicted_ttft_s'] * 1e3:.1f} | "
+            f"{p['measured_ttft_p50_s'] * 1e3:.1f} | "
+            f"{p['completed']}/{p['total']} | "
+            f"{'yes' if p['slo_attainable'] else 'NO'} |"
+        )
+    users = [c["users"] for c in
+             (report.get("plans") or [{}])[0].get("curve", [])]
+    if users:
+        lines += [
+            "",
+            "Replicas needed to serve N users within SLO "
+            "(predicted / measured; `—` = the plan's TTFT blows the "
+            "SLO at any replica count):",
+            "",
+            "| plan | " + " | ".join(f"N={n}" for n in users) + " |",
+            "|---|" + "---|" * len(users),
+        ]
+        for p in report.get("plans", []):
+            cells = []
+            for c in p.get("curve", []):
+                rp = c.get("replicas_predicted")
+                rm = c.get("replicas_measured")
+                cells.append(f"{rp if rp is not None else '—'} / "
+                             f"{rm if rm is not None else '—'}")
+            lines.append(f"| {p['plan']} | " + " | ".join(cells) + " |")
+    lines.append("")
+    return lines
+
+
+def publish_capacity_curve(report: dict[str, Any],
+                           output_dir: "str | Path" = "stats/serving",
+                           ) -> Path:
+    """Publish the capacity curve into the serving report tree: persists
+    ``capacity.json`` (the durable record ``write_serving_report`` folds
+    back in on every regeneration) and rewrites ``SERVING.md`` in place
+    — appending the section when the report exists, emitting a minimal
+    standalone report otherwise."""
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    from dlbb_tpu.utils.config import save_json
+
+    save_json(report, out / "capacity.json")
+    md = out / "SERVING.md"
+    if md.exists():
+        body = md.read_text().splitlines()
+        try:
+            cut = body.index("## Fleet capacity curve")
+            while cut > 0 and body[cut - 1] == "":
+                cut -= 1
+            body = body[:cut]
+        except ValueError:
+            pass
+        while body and body[-1] == "":
+            body.pop()
+        body.append("")
+    else:
+        body = ["# Serving benchmark report", ""]
+    body.extend(_capacity_lines(report))
+    atomic_write_text("\n".join(body), md)
+    return md
 
 
 def write_fastpath_report(bench_path: "str | Path",

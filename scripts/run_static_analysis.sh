@@ -267,6 +267,34 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q \
     -m spec_smoke -p no:cacheprovider
 
+# autotune_smoke (docs/autotune.md): the cm2-driven plan autotuner —
+# full-grid accounting (searched == pruned + ranked, every pruned point
+# journaled with a vocabulary reason), deterministic tie-broken ranking,
+# fail-closed on a missing cm2 fit, the pinned calibration-grid
+# agreement regression (top-2 contains the measured winner for >= 70%
+# of the committed baseline families), and one measured top-1 vs
+# default-heuristic run through the real serving engine.  The CLI run
+# below exercises the static observability surface end-to-end:
+# sweep_manifest.json search accounting + the plan_search_points /
+# plan_agreement_ratio series in metrics.prom.
+JAX_PLATFORMS=cpu python -m pytest tests/test_autotune.py -q \
+    -m autotune_smoke -p no:cacheprovider
+PLAN_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli plan --auto --simulate 8 \
+    --no-measure --output "$PLAN_TMP"
+grep -q 'dlbb_plan_search_points_total{outcome="searched"}' \
+    "$PLAN_TMP/metrics.prom" \
+    || { echo "autotune_smoke: metrics.prom lost the search counters"; \
+         exit 1; }
+grep -q 'dlbb_plan_agreement_ratio{scope="calibration-grid"}' \
+    "$PLAN_TMP/metrics.prom" \
+    || { echo "autotune_smoke: metrics.prom lost the agreement gauge"; \
+         exit 1; }
+grep -q '"searched"' "$PLAN_TMP/sweep_manifest.json" \
+    || { echo "autotune_smoke: manifest lost the search accounting"; \
+         exit 1; }
+rm -rf "$PLAN_TMP"
+
 # compressed-collective smoke (docs/compression.md): int8/fp8 allreduce_q
 # mini-sweep through the real engine + one compressed train step whose
 # losses track the uncompressed run — the HLO-side compression proof

@@ -145,6 +145,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "autotune_smoke: plan-search smoke — the cm2-driven autotuner "
+        "enumerates, prunes (every drop journaled with a reason), ranks "
+        "deterministically, measures the top-k + mesh champions through "
+        "the real serving engine, and the pinned calibration-grid "
+        "agreement stays >= 0.70 (tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )

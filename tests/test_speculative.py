@@ -427,3 +427,48 @@ def test_speculative_report_writer(tmp_path):
     # missing artifact: no rows, nothing clobbered
     assert write_speculative_report(tmp_path / "nope.json",
                                     tmp_path / "stats2") == []
+
+
+# ---------------------------------------------------------------------------
+# sampled decode (temperature > 0): in-engine residual sampling
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_validation_ladder():
+    """temperature > 0 routes decode through the verify unit's residual
+    sampler — every configuration where the knob would silently emit
+    greedy tokens is rejected up front."""
+    with pytest.raises(ValueError, match="requires a drafting"):
+        ServingConfig(**SERVE, temperature=0.8).validate(MODEL)
+    with pytest.raises(ValueError, match="decode_horizon=1"):
+        ServingConfig(**SERVE, speculation="ngram", spec_gamma=4,
+                      temperature=0.8,
+                      decode_horizon=16).validate(MODEL)
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        ServingConfig(**SERVE, speculation="ngram", spec_gamma=4,
+                      temperature=0.8, prefill_chunk=16).validate(MODEL)
+    with pytest.raises(ValueError, match="requires temperature"):
+        ServingConfig(**SERVE, sample_seed=3).validate(MODEL)
+    with pytest.raises(ValueError, match=">= 0"):
+        ServingConfig(**SERVE, temperature=-0.1).validate(MODEL)
+
+
+@pytest.mark.spec_smoke
+def test_sampled_run_replayable_and_seed_sensitive(mesh2x4):
+    """The sampled path runs in-engine through the scheduler: the same
+    (trace seed, sample_seed) pair replays token-identically, a
+    different sample_seed diverges, and the report records the sampled
+    law (temperature, seed, sampled=True)."""
+    trace = _spec_trace(n=6, out=(24, 32))
+    kw = dict(speculation="ngram", spec_gamma=4, temperature=0.8)
+    a = _engine(mesh2x4, **kw, sample_seed=3).run_trace(trace)
+    b = _engine(mesh2x4, **kw, sample_seed=3).run_trace(trace)
+    c = _engine(mesh2x4, **kw, sample_seed=4).run_trace(trace)
+    assert a["requests"]["completed"] == len(trace)
+    assert a["completed_tokens"] == b["completed_tokens"]
+    assert a["completed_tokens"] != c["completed_tokens"]
+    s = a["speculation"]
+    assert s["sampled"] is True
+    assert s["temperature"] == 0.8 and s["sample_seed"] == 3
+    assert s["verify_units"] > 0
+    assert a["cache"]["blocks_reserved"] == 0
