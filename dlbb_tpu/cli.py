@@ -291,8 +291,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     ch.add_argument("--plan", default="all",
                     help="fault class to exercise (compile, transient, "
-                         "nan, torn, hang, ckpt, preempt, kill, serve) "
-                         "or 'all'")
+                         "nan, torn, hang, ckpt, preempt, kill, serve, "
+                         "fleet) or 'all'")
     ch.add_argument("--simulate", type=int, default=8, metavar="N",
                     help="CPU-simulated mesh size (default 8; the gate "
                          "needs no TPU)")
@@ -423,6 +423,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "per-step EMA (requests journaled "
                          "request-failed[reason=hung-dispatch]; "
                          "default: off)")
+    sv.add_argument("--replicas", type=int, default=None, metavar="N",
+                    help="serve through the replica-level fleet "
+                         "supervisor: N failure domains, each its own "
+                         "engine, with health-fencing / failover / "
+                         "hedging / the overload degradation ladder; "
+                         "the parallelism section (or auto-plan) then "
+                         "describes ONE replica's mesh (docs/fleet.md)")
+    sv.add_argument("--hedge-factor", type=float, default=None,
+                    dest="hedge_factor", metavar="F",
+                    help="fleet hedging: duplicate a request still "
+                         "resident past F x the observed p99 latency "
+                         "onto another replica — first completion "
+                         "wins, the loser is canceled (needs "
+                         "--replicas >= 2; docs/fleet.md)")
     sv.add_argument("--fault-plan", default=None, metavar="PLAN",
                     help="deterministic fault-injection plan for the "
                          "serving chaos harness (e.g. "
@@ -795,7 +809,8 @@ def _dispatch(args) -> int:
                   "skipped")
         serve_dir = results_root / "serving"
         if any(p.name != "serving_manifest.json"
-               for p in serve_dir.rglob("serving_*.json")):
+               for p in serve_dir.rglob("serving_*.json")) or \
+                any(serve_dir.rglob("fleet_*.json")):
             from dlbb_tpu.stats.serving_report import write_serving_report
 
             srows = write_serving_report(serve_dir, stats_root / "serving")
@@ -805,6 +820,19 @@ def _dispatch(args) -> int:
                       f"{stats_root / 'serving' / 'SERVING.md'}")
         else:
             print(f"serving: no serving_*.json under {serve_dir} — "
+                  "skipped")
+        bench_fleet = Path("BENCH_fleet.json")
+        if bench_fleet.exists():
+            from dlbb_tpu.stats.serving_report import write_fleet_report
+
+            flrows = write_fleet_report(bench_fleet,
+                                        stats_root / "serving")
+            if flrows:
+                produced += 1
+                print(f"fleet: {len(flrows)} setting(s) -> "
+                      f"{stats_root / 'serving' / 'FLEET.md'}")
+        else:
+            print("fleet: no BENCH_fleet.json at the repo root — "
                   "skipped")
         bench_serve = Path("BENCH_serve.json")
         if bench_serve.exists():
@@ -925,6 +953,7 @@ def _dispatch(args) -> int:
                 "kv_quantization": args.kv_quantization,
                 "temperature": args.temperature,
                 "sample_seed": args.sample_seed,
+                "hedge_factor": args.hedge_factor,
             },
             resume=args.resume,
             fault_plan=args.fault_plan,
@@ -932,8 +961,19 @@ def _dispatch(args) -> int:
             device_trace=args.device_trace,
             prefix_groups=args.prefix_groups,
             prefix_len=args.prefix_len,
+            replicas=args.replicas,
         )
         req = result["requests"]
+        if "failovers" in result:
+            live = sum(1 for r in result["replicas"]
+                       if r["status"] == "ok")
+            print(
+                f"fleet: {live}/{len(result['replicas'])} replica(s) "
+                f"healthy, {result['failovers']['total']} failover(s), "
+                f"{result['hedges']['issued']} hedge(s) issued, "
+                f"degrade level {result['degrade']['level']} "
+                f"({result['degrade']['name']})"
+            )
         if result.get("prefix", {}).get("enabled"):
             pre = result["prefix"]
             print(

@@ -160,6 +160,44 @@ def build_parallelism_mesh(
                       devices=devices)
 
 
+def partition_devices(
+    devices: Optional[Sequence] = None,
+    groups: int = 1,
+) -> list[list]:
+    """Partition the device list into ``groups`` contiguous, equal-size,
+    disjoint failure domains — the replica sub-meshes of the serving
+    fleet (``serve/fleet.py``).
+
+    Contiguity matters: XLA enumerates the simulated (and, on hardware,
+    the physically-adjacent) devices in order, so contiguous slices give
+    each replica the tightest ICI neighbourhood and guarantee no device
+    is shared between domains — one replica's failure can never corrupt
+    another's collectives.  Raises when the device count does not divide
+    evenly (a lopsided fleet would skew every per-replica capacity
+    claim)."""
+    devs = list(devices) if devices is not None else available_devices()
+    if groups < 1:
+        raise ValueError(f"need at least one device group, got {groups}")
+    if len(devs) % groups != 0:
+        raise ValueError(
+            f"{len(devs)} device(s) do not partition into {groups} "
+            "equal failure domains"
+        )
+    per = len(devs) // groups
+    return [devs[i * per:(i + 1) * per] for i in range(groups)]
+
+
+def fault_domain_record(groups: Sequence[Sequence]) -> dict[str, list[int]]:
+    """JSON-able ``fault_domains`` map (replica id -> device ids) for
+    the topology record / serving manifest — the key fleet artifacts
+    carry so fleet runs never silently aggregate with single-replica
+    runs (``utils/simulate.topology_record``)."""
+    return {
+        str(i): [int(getattr(d, "id", j)) for j, d in enumerate(grp)]
+        for i, grp in enumerate(groups)
+    }
+
+
 def mesh_num_ranks(mesh: Mesh, axes: Optional[Sequence[str]] = None) -> int:
     """Total ranks along ``axes`` (all axes if None)."""
     names = tuple(axes) if axes is not None else tuple(mesh.axis_names)

@@ -288,28 +288,61 @@ def load_trace(path: "str | Path") -> dict[str, Any]:
     return json.loads(Path(path).read_text())
 
 
-# the two journal event streams a directory can hold (a sweep and a
+# the journal event streams a directory can hold (a sweep and a
 # serving run may share an output dir — and one append-only journal
-# file): each gets its own Perfetto track group (pid + process_name)
-_SWEEP_PID, _SERVE_PID = 1, 2
+# file): each gets its own Perfetto track group (pid + process_name).
+# Fleet runs (``serve/fleet.py``) add one track group PER REPLICA —
+# every engine-side journal line carries ``replica=N`` through the
+# replica journal proxy — plus a supervisor group for the fleet-level
+# control events (failover, hedging, the degradation ladder), so a
+# crashed fleet run reconstructs replica-by-replica from the journal
+# alone (the PR-8 contract).
+_SWEEP_PID, _SERVE_PID, _FLEET_PID = 1, 2, 3
+_REPLICA_PID_BASE = 10
+
+# supervisor-side fleet lifecycle events rendered as process-scoped
+# instants (full-height markers): each one changes how every later
+# request span on the affected tracks must be read
+_FLEET_LIFECYCLE = ("replica-up", "replica-fenced", "replica-failed",
+                    "request-failover", "request-hedged",
+                    "degrade-transition", "failover-torn", "fleet-stall")
+
+
+def _pid_name(pid: int) -> str:
+    if pid >= _REPLICA_PID_BASE:
+        return f"replica-{pid - _REPLICA_PID_BASE}"
+    return {_SWEEP_PID: "sweep", _SERVE_PID: "serving",
+            _FLEET_PID: "fleet"}[pid]
 
 
 def _classify_stream(records: list[dict[str, Any]]) -> list[int]:
-    """Per-record stream id: serving events (request lifecycle, and any
-    event inside a ``mode: serve`` session) go to the serving track
-    group, everything else to the sweep one.  Session markers
+    """Per-record stream id: events carrying ``replica=N`` (a fleet
+    replica's engine lifecycle) go to that replica's track group;
+    other serving events (request lifecycle, and any event inside a
+    ``mode: serve`` session) go to the serving track group; fleet
+    supervisor events (inside a ``mode: fleet`` session) to the fleet
+    group; everything else to the sweep one.  Session markers
     (``sweep-start``) switch the ambient mode for the events that
-    follow them in file order — both streams interleaved in ONE
+    follow them in file order — the streams interleaved in ONE
     append-only journal split cleanly, instead of the whole file being
     rendered as whichever kind came first."""
     pids: list[int] = []
     ambient = _SWEEP_PID
     for rec in records:
         ev = str(rec.get("event", ""))
+        replica = rec.get("replica")
         if ev == "sweep-start":
-            ambient = (_SERVE_PID if rec.get("mode") == "serve"
+            mode = rec.get("mode")
+            ambient = (_SERVE_PID if mode == "serve"
+                       else _FLEET_PID if mode == "fleet"
                        else _SWEEP_PID)
             pids.append(ambient)
+        elif isinstance(replica, int):
+            pids.append(_REPLICA_PID_BASE + replica)
+        elif ev in _FLEET_LIFECYCLE or ambient == _FLEET_PID and (
+                ev.startswith("request-") or ev.startswith("serve")
+                or ev.startswith("spec-")):
+            pids.append(_FLEET_PID)
         elif (ev.startswith("request-") or ev.startswith("serve")
               or ev.startswith("spec-")):
             pids.append(_SERVE_PID)
@@ -370,8 +403,7 @@ def journal_to_trace(journal_dir: "str | Path",
         events.append({
             "name": "process_name", "ph": "M", "ts": 0.0,
             "pid": pid, "tid": 0,
-            "args": {"name": ("serving" if pid == _SERVE_PID
-                              else "sweep")},
+            "args": {"name": _pid_name(pid)},
         })
     open_configs: dict[tuple[int, str], float] = {}
     for i in order:
@@ -384,7 +416,8 @@ def journal_to_trace(journal_dir: "str | Path",
             open_configs[(pid, config)] = ts_us
         elif (name in ("completed", "failed", "request-completed",
                        "request-rejected", "request-infeasible",
-                       "request-failed", "request-preempted")
+                       "request-failed", "request-preempted",
+                       "request-canceled")
               and (pid, config) in open_configs):
             start_us = open_configs.pop((pid, config))
             kind = name[len("request-"):] if name.startswith(
@@ -394,6 +427,22 @@ def journal_to_trace(journal_dir: "str | Path",
                 "ts": start_us, "dur": max(ts_us - start_us, 0.0),
                 "pid": pid, "tid": 1, "args": _jsonable(args),
             })
+        if name in _FLEET_LIFECYCLE:
+            # fleet lifecycle: full-height, own category — a fence or a
+            # ladder transition recolours every later request span on
+            # the affected tracks, so it must not drown among the
+            # per-request ticks
+            label = name
+            if isinstance(rec.get("replica"), int):
+                label = f"{name}[replica-{rec['replica']}]"
+            elif config:
+                label = f"{name}[{config}]"
+            events.append({
+                "name": label, "cat": "fleet", "ph": "i", "s": "p",
+                "ts": ts_us, "pid": pid, "tid": 1,
+                "args": _jsonable(args),
+            })
+            continue
         if name == "degraded":
             # a degraded-probe fallback (PR 11) changes how EVERY later
             # number in the run must be read — render it as a labelled,
@@ -433,8 +482,7 @@ def journal_to_trace(journal_dir: "str | Path",
             "journal_dir": str(journal_dir),
             "wall_t0": t0,
             "torn_lines": torn,
-            "streams": {str(pid): ("serving" if pid == _SERVE_PID
-                                   else "sweep")
+            "streams": {str(pid): _pid_name(pid)
                         for pid in seen_pids},
         },
     }

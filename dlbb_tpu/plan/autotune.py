@@ -1147,7 +1147,12 @@ def run_capacity_plan(
     ``ceil(N * user_rate * mean_output_tokens / per-replica goodput)``
     replicas, provided the plan's measured TTFT p50 fits the SLO.
     Replica scaling is linear extrapolation (replicas are independent
-    engines behind a round-robin splitter) — stated, not hidden."""
+    engines behind a round-robin splitter) — stated, not hidden.  That
+    assumption is now the literal runtime architecture: ``cli serve
+    --replicas N`` runs the counted replicas as independent failure
+    domains under ``serve/fleet.py``'s supervisor (least-loaded
+    admission, failover re-prefill — docs/fleet.md), and
+    ``BENCH_fleet.json`` prices what a replica death costs the curve."""
     out = Path(output_dir)
     out.mkdir(parents=True, exist_ok=True)
     model_dict = {**DEFAULT_PLAN_MODEL, **(model or {})}

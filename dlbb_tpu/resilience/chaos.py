@@ -34,6 +34,16 @@ trace file fails closed at load; blown-SLO queue heads shed with
 ``reason=deadline``; and SIGTERM mid-trace + ``cli serve --resume``
 reproduces an uninterrupted run's artifact set (names + schema +
 per-request outcomes for non-preempted requests).
+
+The ``fleet`` class (``cli chaos --plan fleet``) runs the replica-level
+fault matrix through the PR-20 fleet supervisor: a replica SIGKILLed
+mid-trace is fenced and its residents fail over with tokens identical
+to an unfaulted single-replica run and zero leaked ledger blocks; a
+torn failover rolls back its routing mutation and retries without
+double-routing; a hung replica is fenced by the heartbeat watchdog
+long before the hang expires; straggling residents are hedged, first
+completion wins, and the losing copy is canceled cleanly; and prefix
+affinity survives the loss of a prefix group's home replica.
 """
 
 from __future__ import annotations
@@ -528,6 +538,255 @@ def _class_serve(work: Path, log: Callable[[str], None]) -> None:
         "non-preempted requests)")
 
 
+def _class_fleet(work: Path, log: Callable[[str], None]) -> None:
+    """Replica-level fault tolerance (``cli chaos --plan fleet``): a
+    2-replica fleet on the simulated mesh survives a replica SIGKILL
+    mid-trace (residents failed over, every surviving request's tokens
+    identical to an unfaulted single-replica run, zero leaked ledger
+    blocks), a torn failover rolls back and retries without
+    double-routing, a hung replica is fenced by the heartbeat watchdog
+    long before the hang expires, and a straggler is hedged — first
+    completion wins, the loser is canceled without corrupting the
+    ledger."""
+    import jax
+
+    from dlbb_tpu.obs.spans import journal_to_trace, load_trace
+    from dlbb_tpu.serve.bench import run_serving
+    from dlbb_tpu.serve.fleet import run_fleet
+    from dlbb_tpu.serve.traffic import Request, TrafficTrace, generate_trace
+
+    model = dict(hidden_size=64, num_layers=2, num_heads=4,
+                 num_kv_heads=4, ffn_intermediate=128, dtype="float32",
+                 attention="full")
+
+    def cfg(name: str, fleet: Optional[dict] = None, **serving) -> dict:
+        base = {"max_batch": 8, "block_size": 8, "max_seq": 64,
+                "queue_capacity": 64, "hbm_budget_gb": None}
+        base.update(serving)
+        # per-replica parallelism: 2 replicas x (dp=2 x tp=2) on the
+        # 8-device simulated mesh
+        return {"experiment": {"name": name}, "model": dict(model),
+                "parallelism": {"data_parallel": 2, "world_size": 2},
+                "serving": base, "fleet": {"replicas": 2, **(fleet or {})}}
+
+    def ref_cfg(name: str, **serving) -> dict:
+        c = cfg(name, **serving)
+        del c["fleet"]
+        return c
+
+    def _tokens_match(rep: dict, ref: dict, what: str) -> None:
+        """Greedy tokens depend only on (params seed, request), so a
+        fleet run on device subsets must reproduce the single-replica
+        reference exactly — including for failed-over / hedged rids."""
+        got, want = rep["completed_tokens"], ref["completed_tokens"]
+        _check(sorted(got) == sorted(want),
+               f"{what}: completed-token rid sets differ")
+        for rid in want:
+            _check(got[rid] == want[rid],
+                   f"{what}: request {rid} tokens diverged after fleet "
+                   f"recovery: {got[rid]} != {want[rid]}")
+
+    def _no_leak(replica: dict, what: str) -> None:
+        cache = replica["report"]["cache"]
+        _check(cache["blocks_reserved"] == 0,
+               f"{what}: replica {replica['replica']} leaked ledger "
+               f"blocks after drain ({cache})")
+        _check(cache.get("shared_blocks", 0) == 0,
+               f"{what}: replica {replica['replica']} leaked shared "
+               f"blocks ({cache})")
+        _check(cache.get("prefix_refs", 0) == 0,
+               f"{what}: replica {replica['replica']} leaked prefix "
+               f"refcounts ({cache})")
+
+    ktrace = generate_trace("poisson", 16, seed=5, rate=60.0,
+                            prompt_range=(4, 12), output_range=(4, 8))
+    ref = run_serving(ref_cfg("flr"), ktrace, verbose=False,
+                      devices=jax.devices()[:4], journal=False,
+                      capture_tokens=True)
+
+    # -- replica SIGKILL mid-trace: fence + failover re-prefill; every
+    #    request completes with tokens identical to the unfaulted
+    #    single-replica reference; the survivor's ledger drains to zero
+    out = work / "fleet_kill"
+    rep = run_fleet(cfg("fk"), ktrace, str(out), verbose=False,
+                    fault_plan="serve-replica-kill:@8")
+    dead = [r for r in rep["replicas"]
+            if r["fence_reason"] == "replica-killed"]
+    _check(len(dead) == 1, f"expected one killed replica, got "
+           f"{[r['fence_reason'] for r in rep['replicas']]}")
+    _check(all(v == "completed"
+               for v in rep["requests"]["outcomes"].values()),
+           f"kill: not all requests recovered: "
+           f"{rep['requests']['outcomes']}")
+    _check(rep["failovers"]["total"] >= 1,
+           "kill fired but no resident was failed over")
+    _check(rep["failovers"]["by_reason"]["replica-killed"]
+           == rep["failovers"]["total"],
+           f"failover reasons inconsistent: {rep['failovers']}")
+    _tokens_match(rep, ref, "kill")
+    survivor = [r for r in rep["replicas"] if r["status"] == "ok"]
+    _check(len(survivor) == 1, "kill: no surviving replica")
+    _no_leak(survivor[0], "kill")
+    _check(rep["failover_ttft_penalty_s"] is not None,
+           "failover TTFT penalty not measured")
+    ev, torn = read_journal(out)
+    _check(torn == 0, f"kill: journal has {torn} torn lines")
+    fo = [e for e in ev if e["event"] == "request-failover"]
+    _check(len(fo) == rep["failovers"]["total"],
+           "failover count diverges from the journal")
+    _check(all(e.get("reason") == "replica-killed" and e.get("error")
+               for e in fo),
+           "request-failover records lack reason + exception chain")
+    _check(any(e["event"] == "replica-fenced"
+               and e.get("reason") == "replica-killed" for e in ev),
+           "journal has no replica-fenced record")
+    # the journal alone reconstructs the fleet lifecycle, one Perfetto
+    # track group per replica
+    timeline, _n, _t = journal_to_trace(out, out / "timeline.json")
+    tl = load_trace(timeline)
+    names = {e["args"]["name"] for e in tl["traceEvents"]
+             if e.get("name") == "process_name"}
+    _check({"fleet", "replica-0", "replica-1"} <= names,
+           f"timeline lacks per-replica track groups: {names}")
+    _check(any(e.get("cat") == "fleet" for e in tl["traceEvents"]),
+           "timeline has no fleet lifecycle instants")
+    (out / "timeline.json").unlink()
+    log(f"fleet kill: replica fenced mid-trace, "
+        f"{rep['failovers']['total']} residents failed over and "
+        f"completed with reference-identical tokens; survivor ledger "
+        f"drained (TTFT penalty "
+        f"{rep['failover_ttft_penalty_s'] * 1e3:.1f}ms)")
+
+    # -- torn failover: the routing mutation rolls back to its snapshot
+    #    and retries; no request is double-routed or lost
+    out = work / "fleet_torn"
+    rep = run_fleet(cfg("ft"), ktrace, str(out), verbose=False,
+                    fault_plan="serve-replica-kill:@8,"
+                               "serve-failover-torn:1")
+    _check(rep["failovers"]["total"] >= 1,
+           "torn: kill fired but no resident was failed over")
+    fo_rids = [r["rid"] for r in rep["failovers"]["requests"]]
+    _check(len(fo_rids) == len(set(fo_rids)),
+           f"torn failover double-routed a request: {fo_rids}")
+    _check(all(v == "completed"
+               for v in rep["requests"]["outcomes"].values()),
+           f"torn: not all requests recovered: "
+           f"{rep['requests']['outcomes']}")
+    _tokens_match(rep, ref, "torn")
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "failover-torn" for e in ev),
+           "journal has no failover-torn rollback record")
+    log("fleet torn: torn routing table rolled back + retried; "
+        "no double-routed request, tokens pinned")
+
+    # -- replica hang: the heartbeat watchdog (the dispatch-EMA
+    #    watchdog generalized to replica granularity) fences the
+    #    replica long before the 120s hang expires
+    out = work / "fleet_hang"
+    t0 = time.perf_counter()
+    rep = run_fleet(
+        cfg("fh", fleet={"heartbeat_min_s": 1.0,
+                         "heartbeat_factor": 4.0}),
+        ktrace, str(out), verbose=False,
+        fault_plan="serve-replica-hang:@8,hang_seconds=120")
+    wall = time.perf_counter() - t0
+    _check(wall < 60.0,
+           f"fleet blocked behind the hung replica ({wall:.1f}s vs "
+           "120s hang)")
+    _check(any(r["fence_reason"] == "replica-hung"
+               for r in rep["replicas"]),
+           f"hung replica not fenced: "
+           f"{[r['fence_reason'] for r in rep['replicas']]}")
+    _check(all(v == "completed"
+               for v in rep["requests"]["outcomes"].values()),
+           f"hang: not all requests recovered: "
+           f"{rep['requests']['outcomes']}")
+    _tokens_match(rep, ref, "hang")
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "replica-fenced"
+               and e.get("reason") == "replica-hung" and e.get("error")
+               for e in ev),
+           "replica-hung fence lacks a journaled heartbeat chain")
+    log(f"fleet hang: heartbeat fenced the silent replica, residents "
+        f"failed over ({wall:.1f}s wall vs 120s hang)")
+
+    # -- hedge-cancel race: a burst pins residents on a replica that
+    #    then hangs briefly; past p99 x hedge_factor the supervisor
+    #    duplicates them onto the survivor, first completion wins, and
+    #    the losing copy is canceled without corrupting either ledger
+    btrace = TrafficTrace(
+        kind="poisson", seed=0, params={},
+        requests=tuple(
+            Request(rid=i, arrival_s=0.0, prompt_len=8, output_len=6,
+                    seed=300 + i)
+            for i in range(16)
+        ),
+    )
+    bref = run_serving(ref_cfg("fbr"), btrace, verbose=False,
+                       devices=jax.devices()[:4], journal=False,
+                       capture_tokens=True)
+    out = work / "fleet_hedge"
+    rep = run_fleet(
+        cfg("fg", fleet={"heartbeat_min_s": 30.0,
+                         "hedge_min_completions": 4},
+            hedge_factor=1.25),
+        btrace, str(out), verbose=False,
+        fault_plan="serve-replica-hang:@6,hang_seconds=4.0")
+    _check(rep["hedges"]["issued"] >= 1,
+           f"straggling residents were never hedged: {rep['hedges']}")
+    _check(rep["hedges"]["won"] >= 1,
+           f"no hedge duplicate won the race: {rep['hedges']}")
+    _check(rep["hedges"]["won"] + rep["hedges"]["lost"]
+           <= rep["hedges"]["issued"],
+           f"hedge accounting inconsistent: {rep['hedges']}")
+    _check(all(v == "completed"
+               for v in rep["requests"]["outcomes"].values()),
+           f"hedge: not all requests completed: "
+           f"{rep['requests']['outcomes']}")
+    _tokens_match(rep, bref, "hedge")
+    # the brief hang recovered — neither replica fenced, both ledgers
+    # drained (the canceled losing copies released their blocks)
+    _check(all(r["status"] == "ok" for r in rep["replicas"]),
+           f"hedge: replica fenced unexpectedly: "
+           f"{[(r['status'], r['fence_reason']) for r in rep['replicas']]}")
+    for r in rep["replicas"]:
+        _no_leak(r, "hedge")
+    ev, _ = read_journal(out)
+    _check(any(e["event"] == "request-hedged" for e in ev),
+           "journal has no request-hedged record")
+    _check(any(e["event"] == "request-canceled"
+               and e.get("reason") == "hedge-lost" for e in ev),
+           "losing hedge copy was never canceled")
+    log(f"fleet hedge: {rep['hedges']['issued']} hedges issued, "
+        f"{rep['hedges']['won']} won; losers canceled, both ledgers "
+        "drained, tokens pinned")
+
+    # -- prefix affinity under fire: a shared-prefix trace routes
+    #    sticky, the kill re-homes the dead replica's prefix group, and
+    #    the survivor's trie refcounts still drain to zero
+    ptrace = generate_trace("poisson", 10, seed=5, rate=200.0,
+                            prompt_range=(17, 28), output_range=(3, 6),
+                            prefix_groups=2, prefix_len=16)
+    pcfg = cfg("fp", prefill_chunk=8, prefix_caching=True)
+    # prefix caching is a dp=1 feature; 2 replicas x (dp=1 x tp=4)
+    pcfg["parallelism"] = {"data_parallel": 1, "world_size": 4}
+    out = work / "fleet_prefix"
+    rep = run_fleet(pcfg, ptrace, str(out), verbose=False,
+                    fault_plan="serve-replica-kill:@10")
+    _check(rep["routing"]["prefix_affinity_hits"] >= 1,
+           "prefix trace produced no affinity-routed request")
+    _check(all(v == "completed"
+               for v in rep["requests"]["outcomes"].values()),
+           f"prefix: not all requests recovered: "
+           f"{rep['requests']['outcomes']}")
+    survivor = [r for r in rep["replicas"] if r["status"] == "ok"]
+    _check(len(survivor) == 1, "prefix: no surviving replica")
+    _no_leak(survivor[0], "prefix kill")
+    log(f"fleet prefix: affinity routing held "
+        f"({rep['routing']['prefix_affinity_hits']} hits), killed "
+        "replica's prefix group re-homed, survivor trie drained")
+
+
 CHAOS_CLASSES: dict[str, Callable[[Path, Callable[[str], None]], None]] = {
     "compile": _class_compile,
     "transient": _class_transient,
@@ -538,6 +797,7 @@ CHAOS_CLASSES: dict[str, Callable[[Path, Callable[[str], None]], None]] = {
     "preempt": _class_preempt,
     "kill": _class_kill,
     "serve": _class_serve,
+    "fleet": _class_fleet,
 }
 
 

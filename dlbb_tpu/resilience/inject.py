@@ -90,6 +90,27 @@ byte-identical with or without a plan, pinned statically by
                        process (graceful drain + checkpoint +
                        ``cli serve --resume``)
 =====================  ==================================================
+
+Fleet sites (``serve/fleet.py`` + the replica control plane checked at
+the engine's scheduler-loop boundary; all strictly host-side — the
+static zero-injection pin extends to ``fleet.py`` via
+``tests/test_fleet.py``):
+
+========================  ===============================================
+``serve-replica-kill``    replica loop boundary — raises
+                          :class:`~dlbb_tpu.serve.fleet.ReplicaKilled`
+                          out of the engine (simulated replica SIGKILL:
+                          no report, no cleanup; the supervisor fences
+                          the replica and fails its residents over)
+``serve-replica-hang``    replica loop boundary — sleeps
+                          ``hang_seconds`` (the per-replica heartbeat
+                          watchdog must fence the silent replica)
+``serve-failover-torn``   supervisor routing-table update mid-failover —
+                          raises :class:`TornWrite` after the mutation,
+                          before any feed push (the snapshot/restore
+                          discipline must roll back and retry without
+                          double-routing a request)
+========================  ===============================================
 """
 
 from __future__ import annotations
@@ -137,6 +158,9 @@ SITES: tuple[str, ...] = (
     "serve-cache-torn",
     "serve-trace-corrupt",
     "serve-preempt",
+    "serve-replica-kill",
+    "serve-replica-hang",
+    "serve-failover-torn",
 )
 
 _DEFAULT_PARAMS = {

@@ -144,6 +144,7 @@ def run_serving(
     fault_plan: Optional[str] = None,
     collect_raw: bool = False,
     device_trace: Optional[str] = None,
+    capture_tokens: bool = False,
 ) -> dict[str, Any]:
     """Run one trace-driven serving benchmark.
 
@@ -215,6 +216,7 @@ def run_serving(
                 journal=jrn,
                 seed=config.get("input", {}).get("seed", 0),
                 verbose=verbose,
+                capture_tokens=capture_tokens,
             )
             # degraded-probe fallbacks are first-class events (ROADMAP
             # standing chore): journaled AND counted, not just a field
@@ -308,6 +310,10 @@ def run_serving(
             "mesh": report["mesh"],
             "hbm": report["hbm"],
             "topology": topology,
+            # replica id -> device ids for fleet runs (serve/fleet.py
+            # writes its own manifest); None marks a single-replica run
+            # so overlays never silently aggregate across the two
+            "fault_domains": topology.get("fault_domains"),
             "journal": (None if jrn is None else jrn.path.name),
         }
         save_json(manifest, out / "serving_manifest.json")
@@ -337,7 +343,7 @@ def merge_reports(partial: dict[str, Any],
     req: dict[str, Any] = {
         k: req_a.get(k, 0) + req_b.get(k, 0)
         for k in ("arrived", "admitted", "rejected", "completed",
-                  "failed", "preempted", "deadline_shed",
+                  "failed", "preempted", "canceled", "deadline_shed",
                   "completed_past_deadline")
     }
     req["rejected_detail"] = (list(req_a.get("rejected_detail", []))
@@ -589,6 +595,7 @@ def run_serve_from_config(
     device_trace: Optional[str] = None,
     prefix_groups: Optional[int] = None,
     prefix_len: Optional[int] = None,
+    replicas: Optional[int] = None,
 ) -> dict[str, Any]:
     """CLI entry: optional experiment YAML + flag overrides (including
     the decode fast-path knobs — decode_horizon / inflight_window /
@@ -601,7 +608,13 @@ def run_serve_from_config(
     KV") — the traffic shape the ``prefix_caching`` engine exploits.
 
     Without ``--config`` the default small GQA model serves on an
-    auto-planned (dp, tp) mesh over the available devices."""
+    auto-planned (dp, tp) mesh over the available devices.
+
+    ``--replicas N`` (or a ``fleet:`` config section) routes the trace
+    through the replica-level fleet supervisor instead — N failure
+    domains, each its own engine, with health-fencing / failover /
+    hedging / the degradation ladder (docs/fleet.md); the
+    ``parallelism:`` section then describes ONE replica's mesh."""
     import jax
 
     from dlbb_tpu.utils.config import load_config
@@ -619,10 +632,17 @@ def run_serve_from_config(
             if value is not None:
                 config["serving"][key] = value
     serving_cfg = ServingConfig.from_dict(config["serving"])
+    if replicas is not None and replicas > 1:
+        config.setdefault("fleet", {})["replicas"] = replicas
+    fleet = bool(config.get("fleet"))
     if "parallelism" not in config:
         model_cfg = ModelConfig.from_dict(config.get("model",
                                                      DEFAULT_SERVE_MODEL))
         n = len(devices) if devices is not None else len(jax.devices())
+        if fleet:
+            # fleet parallelism is PER REPLICA: auto-plan within one
+            # failure domain's device share
+            n //= max(1, int(config["fleet"].get("replicas", 2)))
         dp, tp = default_parallelism(n, model_cfg.kv_heads,
                                      serving_cfg.max_batch)
         config["parallelism"] = {"data_parallel": dp, "world_size": tp}
@@ -636,6 +656,12 @@ def run_serve_from_config(
                              deadline_s=slo, **trace_kw)
     out = output_dir or config.get("experiment", {}).get(
         "output_dir", "results/serving")
+    if fleet:
+        from dlbb_tpu.serve.fleet import run_fleet
+
+        return run_fleet(config, resolved, output_dir=out,
+                         devices=devices, verbose=verbose,
+                         fault_plan=fault_plan)
     return run_serving(config, resolved, output_dir=out, devices=devices,
                        verbose=verbose, fault_plan=fault_plan,
                        device_trace=device_trace)

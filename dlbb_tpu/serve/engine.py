@@ -287,6 +287,16 @@ class ServingConfig:
     sample_seed:     host RNG seed of the sampled path (with the trace
                      seed this makes sampled runs replayable); only
                      meaningful with temperature > 0.
+    hedge_factor:    fleet-level straggler hedging knob (``serve/
+                     fleet.py``; ignored by a single-engine run): a
+                     request still outstanding past ``hedge_factor`` x
+                     the observed p99 end-to-end latency is duplicated
+                     onto a second replica — first completion wins, the
+                     loser is canceled and its blocks freed.  Greedy
+                     token sequences depend only on (params, request
+                     seed), so the committed tokens are identical
+                     whichever copy wins.  None (default) disables
+                     hedging; must be > 1.0 when set.
     """
 
     max_batch: int = 8
@@ -314,6 +324,7 @@ class ServingConfig:
     kv_quantization: str = "none"
     temperature: float = 0.0
     sample_seed: int = 0
+    hedge_factor: Optional[float] = None
 
     def __post_init__(self) -> None:
         if not self.prefill_buckets:
@@ -369,6 +380,11 @@ class ServingConfig:
             raise ValueError(
                 f"serving.queue_capacity must be >= 1, got "
                 f"{self.queue_capacity}"
+            )
+        if self.hedge_factor is not None and self.hedge_factor <= 1.0:
+            raise ValueError(
+                f"serving.hedge_factor must be > 1.0 (it scales the "
+                f"observed p99 latency), got {self.hedge_factor}"
             )
         if self.total_blocks < 1:
             raise ValueError(
@@ -650,7 +666,8 @@ class ServingConfig:
                   "dispatch_deadline_min_s", "speculation", "spec_gamma",
                   "spec_adaptive", "spec_draft_layers",
                   "spec_draft_kv_heads", "prefix_caching",
-                  "kv_quantization", "temperature", "sample_seed"):
+                  "kv_quantization", "temperature", "sample_seed",
+                  "hedge_factor"):
             if k in d:
                 fields[k] = d[k]
         if "prefill_buckets" in d:
@@ -685,6 +702,7 @@ class ServingConfig:
             "kv_quantization": self.kv_quantization,
             "temperature": self.temperature,
             "sample_seed": self.sample_seed,
+            "hedge_factor": self.hedge_factor,
         }
 
     @property
@@ -1883,11 +1901,14 @@ class ServingEngine:
         # public and reassignable: the bench wires one journal per run
         # directory; tests swap it between run_trace calls
         self.journal = journal
+        # fleet-replica control plane for the CURRENT run (run_trace's
+        # ``control=``); None outside a fleet
+        self._control: Any = None
         self.registry = registry if registry is not None else MetricsRegistry()
         self._requests = self.registry.labeled_counter(
             "serve_requests", "outcome",
             initial=("arrived", "admitted", "rejected", "completed",
-                     "failed", "preempted"),
+                     "failed", "preempted", "canceled"),
             help="request lifecycle outcomes",
         )
         self._rejections = self.registry.labeled_counter(
@@ -2327,12 +2348,24 @@ class ServingEngine:
     def _event(self, event: str, rid: int, **extra: Any) -> None:
         if self.journal is not None:
             self.journal.event(event, config=f"request-{rid}", **extra)
+        ctl = self._control
+        if ctl is not None and getattr(ctl, "on_event", None) is not None:
+            # live lifecycle feed to the fleet supervisor (terminal
+            # accounting, hedge winner detection); a sink failure must
+            # never take the replica down — the journal line above is
+            # already durable
+            try:
+                ctl.on_event(rid, event, dict(extra))
+            except Exception:  # noqa: BLE001 — contained by contract
+                pass
 
     # -- the run -----------------------------------------------------------
 
     def run_trace(self, trace: TrafficTrace,
                   guard: Optional[PreemptionGuard] = None,
-                  collect_raw: bool = False) -> dict[str, Any]:
+                  collect_raw: bool = False,
+                  feed: Any = None,
+                  control: Any = None) -> dict[str, Any]:
         """Serve ``trace`` to completion (or to a graceful preemption
         drain); returns the report dict (``docs/serving.md`` documents
         every field).  Pure compute + host scheduling — writing
@@ -2346,14 +2379,26 @@ class ServingEngine:
         ``preempted=True`` + ``remaining_rids`` — the snapshot
         ``cli serve --resume`` replays.  ``collect_raw`` adds the raw
         latency sample lists to the report (``raw_samples``; always
-        present on a preempted report so resume can merge honestly)."""
+        present on a preempted report so resume can merge honestly).
+
+        ``feed``/``control`` are the fleet-replica hooks
+        (``serve/fleet.py``): ``feed`` replaces the static arrival
+        deque with a supervisor-fed :class:`~dlbb_tpu.serve.fleet.
+        RequestFeed` (``trace`` is still used for compile planning and
+        feasibility), and ``control`` is the replica control plane —
+        heartbeat, kill/hang fault sites, hedge cancels, degradation
+        overrides, and the fleet-shared clock origin — checked strictly
+        at the scheduler-loop boundary."""
         if guard is None:
             with PreemptionGuard() as own:
-                return self._serve_trace(trace, own, collect_raw)
-        return self._serve_trace(trace, guard, collect_raw)
+                return self._serve_trace(trace, own, collect_raw,
+                                         feed, control)
+        return self._serve_trace(trace, guard, collect_raw, feed, control)
 
     def _serve_trace(self, trace: TrafficTrace, guard: PreemptionGuard,
-                     collect_raw: bool) -> dict[str, Any]:
+                     collect_raw: bool, feed: Any = None,
+                     control: Any = None) -> dict[str, Any]:
+        self._control = control
         if not len(trace):
             raise ValueError("cannot serve an empty trace")
         cfg = self.serving
@@ -2386,7 +2431,12 @@ class ServingEngine:
         # (Prometheus semantics); the report carries THIS run's deltas
         counts_base = {k: self._requests[k] for k in self._requests}
         shed_base = self._rejections["queue-full"]
-        pending = deque(sorted(trace, key=lambda r: (r.arrival_s, r.rid)))
+        # a fleet supervisor feeds arrivals dynamically (and re-feeds
+        # failovers at queue head); a standalone run serves the static
+        # trace in arrival order
+        pending = (feed if feed is not None
+                   else deque(sorted(trace,
+                                     key=lambda r: (r.arrival_s, r.rid))))
         queue: deque[Request] = deque()
         slots: dict[int, _SlotState] = {}
         free_slots = list(range(cfg.max_batch))
@@ -2480,6 +2530,12 @@ class ServingEngine:
                 stats.completed_past_deadline += 1
                 self._deadline_counter["completed-late"] += 1
                 extra["past_deadline"] = True
+            if self.capture_tokens:
+                # tokens ride the completion event so a fleet supervisor
+                # keeps them even when this replica dies right after
+                # (its report — the usual carrier — dies with it)
+                extra["tokens"] = [int(t) for t in
+                                   tokens_by_rid.get(st.req.rid, [])]
             self._event("request-completed", st.req.rid,
                         output_tokens=st.req.output_len,
                         latency_s=round(lat, 6), **extra)
@@ -2537,6 +2593,43 @@ class ServingEngine:
             the whole resident batch), freeing their slots + blocks."""
             fail_requests([release(s) for s in sorted(list(slots))],
                           exc, reason)
+
+        def cancel_request(rid: int, reason: str) -> None:
+            """Supervisor-requested cancel (serve/fleet.py: the losing
+            hedge duplicate).  Resident: the in-flight window settles
+            first so the release happens at a sync point, then the
+            slot's blocks are freed.  Queued / not-yet-fed: the request
+            is simply dropped.  An unknown rid is a benign race — the
+            request completed between the cancel decision and this loop
+            boundary — and a no-op by design (the tokens are identical
+            on both replicas, so a double completion is harmless)."""
+            slot = next((s for s, st in slots.items()
+                         if st.req.rid == rid), None)
+            if slot is not None:
+                drain()
+                st_now = slots.get(slot)
+                if st_now is None or st_now.req.rid != rid:
+                    return  # completed (or failed) at the drain sync
+                st = release(slot)
+                hist.pop(rid, None)
+                outcomes[rid] = f"canceled[{reason}]"
+                self._requests["canceled"] += 1
+                self._event("request-canceled", rid, reason=reason,
+                            tokens_done=st.tokens_done)
+                return
+            for r in list(queue):
+                if r.rid == rid:
+                    queue.remove(r)
+                    outcomes[rid] = f"canceled[{reason}]"
+                    self._requests["canceled"] += 1
+                    self._event("request-canceled", rid, reason=reason,
+                                tokens_done=0)
+                    return
+            if feed is not None and feed.discard(rid):
+                outcomes[rid] = f"canceled[{reason}]"
+                self._requests["canceled"] += 1
+                self._event("request-canceled", rid, reason=reason,
+                            tokens_done=0)
 
         # EMA of the observed per-step interval: the horizon policy uses
         # it to convert "next arrival in X seconds" into a step budget,
@@ -2598,8 +2691,6 @@ class ServingEngine:
                 for _ in range(steps):
                     stats.per_token_s.append(dt / unit["k_exec"])
             done_at = self._now()
-            for st in unit["completions"]:
-                finish(st, done_at)
             if unit.get("tokens"):
                 # token-feedback unit: ys are the committed token ids
                 # themselves ([B] per-step, [k, B] fused) — the n-gram
@@ -2622,6 +2713,10 @@ class ServingEngine:
                     for i in range(steps):
                         tokens_by_rid.setdefault(rid, []).append(
                             int(np.argmax(ys_np[i, row, 0])))
+            # finish AFTER the unit's token capture: the completion
+            # event carries the request's full committed token list
+            for st in unit["completions"]:
+                finish(st, done_at)
 
         def drain() -> None:
             while inflight:
@@ -3170,7 +3265,8 @@ class ServingEngine:
             carry."""
             nonlocal carry
             refresh_active()
-            if spec_on and max_k is None:
+            if (spec_on and max_k is None
+                    and (control is None or control.spec_enabled)):
                 # draft-and-verify first; a cold n-gram drafter falls
                 # through to a plain token decode unit below (the
                 # chunked-prefill interleave's max_k=1 also bypasses
@@ -3187,6 +3283,12 @@ class ServingEngine:
             horizon = (min(rem.values()) if (queue or pending)
                        else max(rem.values()))
             horizon = min(cfg.decode_horizon, horizon)
+            if control is not None and control.horizon_cap is not None:
+                # degradation ladder (serve/fleet.py): a shrunk horizon
+                # trades fused-scan throughput for scheduling latency
+                # under overload — never silently (each transition is
+                # journaled ``degrade-transition``)
+                horizon = min(horizon, max(1, control.horizon_cap))
             if pending:
                 # a known arrival is a scheduling event too: bound the
                 # scan so admission happens near the arrival instead of
@@ -3478,10 +3580,24 @@ class ServingEngine:
                 carry = self._fresh_carry()
                 draft_cache[0] = self._fresh_draft_cache()
 
-        self._t0 = time.perf_counter()
+        # a fleet run shares one clock origin across every replica (the
+        # supervisor's barrier sets it after ALL replicas have compiled,
+        # so per-replica compile skew never distorts arrival/deadline
+        # accounting); a standalone run starts its own
+        self._t0 = (control.sync_start() if control is not None
+                    else time.perf_counter())
         last_sync[0] = self._t0
         preempted = False
         while pending or queue or slots:
+            if control is not None:
+                # replica control plane (serve/fleet.py), strictly at
+                # the loop boundary so a fence can never tear a
+                # half-applied dispatch: heartbeat, injected replica
+                # kill/hang, supervisor cancels (losing hedges)
+                control.beat()
+                control.check()
+                for c_rid, c_reason in control.take_cancels():
+                    cancel_request(c_rid, c_reason)
             if inject.fire("serve-preempt"):
                 # chaos harness: deliver a real SIGTERM to ourselves —
                 # the PreemptionGuard turns it into the drain flag below
@@ -3608,6 +3724,8 @@ class ServingEngine:
                             # (one [H] vector per admission), the first
                             # token is drawn from their softmax, and
                             # the device only embeds the committed id
+                            # (once per ADMISSION, not per token)
+                            # comm-lint: disable=host-transfer-in-loop
                             p0 = softmax_np(np.asarray(y_last),
                                             cfg.temperature)
                             first_id = int(sample_rng.choice(
@@ -3790,7 +3908,8 @@ class ServingEngine:
             "requests": {
                 **{k: self._requests[k] - counts_base[k]
                    for k in ("arrived", "admitted", "rejected",
-                             "completed", "failed", "preempted")},
+                             "completed", "failed", "preempted",
+                             "canceled")},
                 "rejected_rids": [d["rid"] for d in rejected_detail],
                 "rejected_detail": rejected_detail,
                 "shed_rate": (shed / arrived) if arrived else 0.0,

@@ -26,6 +26,8 @@ CSV_COLUMNS = (
     "draft_overhead_s",
     "kv_quant", "prefix_hit_rate", "prefix_tokens_reused",
     "prefix_cow_blocks",
+    "replicas", "failovers", "failover_penalty_ms",
+    "hedges_issued", "hedges_won", "degrade_level",
     "wall_seconds",
 )
 
@@ -123,6 +125,15 @@ def serving_row(report: dict[str, Any], name: str) -> dict[str, Any]:
                                  if pre.get("enabled") else None),
         "prefix_cow_blocks": (pre.get("cow_blocks")
                               if pre.get("enabled") else None),
+        # fleet-level robustness (docs/fleet.md): absent from
+        # single-replica engine reports — all None then
+        "replicas": (len(report["replicas"])
+                     if report.get("replicas") else None),
+        "failovers": report.get("failovers", {}).get("total"),
+        "failover_penalty_ms": _ms(report, "failover_ttft_penalty_s"),
+        "hedges_issued": report.get("hedges", {}).get("issued"),
+        "hedges_won": report.get("hedges", {}).get("won"),
+        "degrade_level": report.get("degrade", {}).get("name"),
         "wall_seconds": round(report.get("wall_seconds", 0.0), 3),
     }
 
@@ -135,15 +146,20 @@ def write_serving_report(results_dir: "str | Path",
     a committed report with an empty table)."""
     results_dir = Path(results_dir)
     rows = []
-    for path in sorted(results_dir.rglob("serving_*.json")):
+    paths = sorted(list(results_dir.rglob("serving_*.json"))
+                   + list(results_dir.rglob("fleet_*.json")))
+    for path in paths:
         if path.name == "serving_manifest.json":
             continue
         try:
             report = json.loads(path.read_text())
         except (OSError, json.JSONDecodeError):
             continue
-        if report.get("schema", "").startswith("dlbb_serving_report"):
-            rows.append(serving_row(report, path.stem[len("serving_"):]))
+        schema = report.get("schema", "")
+        if schema.startswith(("dlbb_serving_report", "dlbb_fleet_report")):
+            prefix = ("serving_" if path.name.startswith("serving_")
+                      else "fleet_")
+            rows.append(serving_row(report, path.stem[len(prefix):]))
     if not rows:
         return rows
     out = Path(output_dir)
@@ -184,16 +200,22 @@ def write_serving_report(results_dir: "str | Path",
         "\"pfx hit\" the shared-prefix attach rate (prefix-cache hits / "
         "prefills) and \"pfx tok\" the prompt tokens whose prefill was "
         "skipped by attaching refcounted donor blocks (docs/serving.md, "
-        "\"Prefix cache & quantized KV\").",
+        "\"Prefix cache & quantized KV\").  Fleet rows "
+        "(`fleet_*.json`, `cli serve --replicas N`, docs/fleet.md) add "
+        "\"repl\" (failure domains; the mesh column is then ONE "
+        "replica's mesh), \"failover\" (requests re-prefilled off a "
+        "fenced replica, with the mean TTFT penalty vs clean requests "
+        "in ms), \"hedge\" (duplicates won / issued) and \"degrade\" "
+        "(the overload ladder's final level).",
         "",
         "| run | trace | req | done | rej | failed | shed | dl shed | "
         "late | rej wait ms | mesh | "
         "goodput tok/s | "
         "TTFT p50/p99/p99.9 ms | tok p50/p99/p99.9 ms | peak queue | "
         "peak blocks | spec | acc | acc len | draft s | kv | pfx hit | "
-        "pfx tok |",
+        "pfx tok | repl | failover (pen ms) | hedge | degrade |",
         "|---|---|---|---|---|---|---|---|---|---|---|---|---|---|---|"
-        "---|---|---|---|---|---|---|---|",
+        "---|---|---|---|---|---|---|---|---|---|---|---|",
     ]
     for r in rows:
         shed = ("-" if r["shed_rate"] is None
@@ -217,6 +239,24 @@ def write_serving_report(results_dir: "str | Path",
                    else f"{r['prefix_hit_rate'] * 100:.0f}%")
         pfx_tok = ("-" if r["prefix_tokens_reused"] is None
                    else r["prefix_tokens_reused"])
+        # fleet columns (docs/fleet.md): "-" on single-replica rows
+        repl = "-" if r["replicas"] is None else r["replicas"]
+        if r["failovers"] is None:
+            fo = "-"
+        elif r["failover_penalty_ms"] is not None:
+            fo = f"{r['failovers']} ({r['failover_penalty_ms']:.1f})"
+        else:
+            fo = f"{r['failovers']}"
+        hedge = ("-" if r["hedges_issued"] is None
+                 else f"{r['hedges_won']}/{r['hedges_issued']}")
+        degrade = r["degrade_level"] or "-"
+        # per-token latency / cache peaks are engine-level; a fleet
+        # row's aggregate view doesn't carry them
+        ptl = ("-" if r["per_token_p50_ms"] is None else
+               f"{r['per_token_p50_ms']}/{r['per_token_p99_ms']}/"
+               f"{r['per_token_p999_ms']}")
+        peak_blocks = ("-" if r["peak_blocks_in_use"] is None
+                       else r["peak_blocks_in_use"])
         lines.append(
             f"| {r['name']} | {r['trace']} | {r['requests']} | "
             f"{r['completed']} | {r['rejected']} | {failed} | {shed} | "
@@ -224,11 +264,10 @@ def write_serving_report(results_dir: "str | Path",
             f"{r['mesh']} | "
             f"{r['goodput_tok_s']} | "
             f"{r['ttft_p50_ms']}/{r['ttft_p99_ms']}/{r['ttft_p999_ms']} | "
-            f"{r['per_token_p50_ms']}/{r['per_token_p99_ms']}/"
-            f"{r['per_token_p999_ms']} | "
-            f"{r['peak_queue_depth']} | {r['peak_blocks_in_use']} | "
+            f"{ptl} | "
+            f"{r['peak_queue_depth']} | {peak_blocks} | "
             f"{spec} | {acc} | {mal} | {draft_s} | {kv} | {pfx_hit} | "
-            f"{pfx_tok} |"
+            f"{pfx_tok} | {repl} | {fo} | {hedge} | {degrade} |"
         )
     lines.append("")
     # the capacity planner's durable record lives next to the report —
@@ -516,6 +555,89 @@ def write_speculative_report(bench_path: "str | Path",
         )
     lines.append("")
     atomic_write_text("\n".join(lines), out / "SPECULATIVE.md")
+    return rows
+
+
+def write_fleet_report(bench_path: "str | Path",
+                       output_dir: "str | Path") -> list[dict[str, Any]]:
+    """The fleet fault-tolerance table: consolidate ``BENCH_fleet.json``
+    (``scripts/bench_fleet.py`` — single-engine oracle vs clean 2-replica
+    fleet vs replica-killed fleet over the same seeded trace) into
+    ``FLEET.md``.  Returns the rows (empty when the bench artifact is
+    missing/unreadable — callers skip, never clobber)."""
+    bench_path = Path(bench_path)
+    try:
+        bench = json.loads(bench_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return []
+    settings = bench.get("settings", {})
+    if not settings:
+        return []
+    rows = []
+    for name, s in settings.items():
+        tps = s.get("goodput_tokens_per_s", {})
+        fo = s.get("failovers", {})
+        rows.append({
+            "setting": name,
+            "goodput_median": tps.get("median"),
+            "goodput_min": tps.get("min"),
+            "goodput_max": tps.get("max"),
+            "ttft_p50_ms": s.get("ttft_p50_ms"),
+            "ttft_p99_ms": s.get("ttft_p99_ms"),
+            "failovers": fo.get("median"),
+            "token_identical": s.get("token_identical"),
+        })
+    failover = bench.get("failover", {})
+    pen = failover.get("ttft_penalty_ms", {})
+    fleet = bench.get("fleet", {})
+    trace = bench.get("trace", {})
+    out = Path(output_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    lines = [
+        "# Replica-level fault tolerance: the cost of a failover",
+        "",
+        f"Source: `{bench_path.name}` "
+        "(`scripts/bench_fleet.py` — the SAME seeded "
+        f"{trace.get('kind', '?')} trace "
+        f"(n={trace.get('requests', '?')}, seed={trace.get('seed', '?')}) "
+        "through a single replica-sized engine (the token oracle), a "
+        f"clean {fleet.get('replicas', '?')}-replica fleet, and the same "
+        "fleet with `serve-replica-kill` fired mid-trace; settings "
+        "interleaved within each repetition, medians with min/max "
+        "spread; docs/fleet.md).  Every fleet run — clean AND killed — "
+        "is gated token-identical to the oracle before publishing, so "
+        "the penalty prices recovery of the SAME answer, not a "
+        "different one.  The TTFT penalty is failed-over minus clean "
+        "requests WITHIN the kill run (queueing drift between runs "
+        "cancels).",
+        "",
+        "| setting | goodput tok/s (min..max) | TTFT p50 ms | "
+        "TTFT p99 ms | failovers | identical |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        tps = ("-" if r["goodput_median"] is None else
+               f"{r['goodput_median']:.0f} "
+               f"({r['goodput_min']:.0f}..{r['goodput_max']:.0f})")
+        fo = "-" if r["failovers"] is None else r["failovers"]
+        ident = ("-" if r["token_identical"] is None
+                 else ("yes" if r["token_identical"] else "NO"))
+        lines.append(
+            f"| {r['setting']} | {tps} | {r['ttft_p50_ms']} | "
+            f"{r['ttft_p99_ms']} | {fo} | {ident} |"
+        )
+    if pen:
+        lines += [
+            "",
+            f"**Failover TTFT penalty: {pen.get('median', '?')} ms** "
+            f"({pen.get('min', '?')}..{pen.get('max', '?')} across "
+            f"reps), {failover.get('failovers_per_run', {}).get('median', '?')} "
+            "failover(s) per kill run; goodput retained "
+            f"**{failover.get('goodput_retained_vs_clean_fleet', '?')}x** "
+            "vs the unfaulted fleet.",
+        ]
+    lines.append("")
+    atomic_write_text("\n".join(lines), out / "FLEET.md")
     return rows
 
 

@@ -73,7 +73,9 @@ def degraded_reason() -> Optional[str]:
     return _DEGRADED_REASON
 
 
-def topology_record() -> dict[str, Any]:
+def topology_record(
+    fault_domains: Optional[dict[str, Any]] = None,
+) -> dict[str, Any]:
     """The topology fingerprint every sweep artifact set carries
     (``sweep_manifest.json`` ``topology`` key + a ``topology`` journal
     event): which platform actually backs the mesh, how many devices and
@@ -81,7 +83,12 @@ def topology_record() -> dict[str, Any]:
     probe-fallback (:func:`force_cpu_simulation` with a reason) or a
     silent landing on CPU that nobody requested (the exact failure mode
     of rounds 4–5, where the tunnel died and benches fell back without a
-    durable record)."""
+    durable record).
+
+    ``fault_domains`` (serving fleets only — ``serve/fleet.py``) maps
+    replica id -> device ids; its presence marks the artifact as a
+    FLEET run, and overlay/report tooling keys on it so fleet numbers
+    never silently aggregate with single-replica numbers."""
     import jax
 
     platform = jax.default_backend()
@@ -106,4 +113,6 @@ def topology_record() -> dict[str, Any]:
             "process landed on the CPU backend without simulation being "
             "requested (accelerator plugin unavailable?)"
         )
+    if fault_domains is not None:
+        rec["fault_domains"] = dict(fault_domains)
     return rec

@@ -267,6 +267,32 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serve_resilience.py -q \
 JAX_PLATFORMS=cpu python -m pytest tests/test_speculative.py -q \
     -m spec_smoke -p no:cacheprovider
 
+# fleet_smoke (docs/fleet.md): replica-level fault tolerance — the
+# 2-replica fleet supervisor on the simulated mesh must route
+# deterministically (least-loaded with prefix affinity), survive a
+# mid-trace replica kill with every resident failed over and the
+# completed tokens byte-identical to the single-engine oracle, walk
+# the degradation ladder monotonically with every transition journaled
+# and counted, and write the full fleet artifact family (fleet report
+# + manifest fault_domains + per-replica journal tracks + the
+# failover/hedge/degrade metric families).  The supervisor stays
+# provably host-side: the zero-injection pin asserts serve/fleet.py
+# builds no device program at all, so a fleet (or a fault plan) can
+# never change the jitted prefill/decode HLO the audits above pin.
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet.py -q \
+    -m fleet_smoke -p no:cacheprovider
+FLEET_TMP="$(mktemp -d)"
+JAX_PLATFORMS=cpu python -m dlbb_tpu.cli serve --simulate 8 \
+    --requests 8 --rate 80 --seed 11 --replicas 2 \
+    --output "$FLEET_TMP" >/dev/null
+grep -q 'dlbb_serve_failovers_total' "$FLEET_TMP/metrics.prom" \
+    || { echo "fleet_smoke: metrics.prom lost the failover counters"; \
+         exit 1; }
+grep -q '"fault_domains"' "$FLEET_TMP/serving_manifest.json" \
+    || { echo "fleet_smoke: manifest lost the fault_domains record"; \
+         exit 1; }
+rm -rf "$FLEET_TMP"
+
 # autotune_smoke (docs/autotune.md): the cm2-driven plan autotuner —
 # full-grid accounting (searched == pruned + ranked, every pruned point
 # journaled with a vocabulary reason), deterministic tie-broken ranking,

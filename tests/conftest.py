@@ -154,6 +154,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "fleet_smoke: replica-fleet smoke — a 2-replica fleet on the "
+        "simulated mesh routes deterministically with prefix affinity, "
+        "survives a replica kill with failover re-prefill and "
+        "reference-identical tokens, walks the degradation ladder "
+        "monotonically, and the zero-injection pin holds over "
+        "serve/fleet.py (tier-1; also invoked standalone by "
+        "scripts/run_static_analysis.sh)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: excluded from the tier-1 `-m 'not slow'` run (subprocess "
         "chaos classes, multi-minute sweeps)",
     )
